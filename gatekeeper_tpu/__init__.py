@@ -1,0 +1,14 @@
+"""gatekeeper_tpu — a TPU-native policy-enforcement framework.
+
+A ground-up rebuild of OPA Gatekeeper's capabilities (reference:
+/root/reference, an OPA Gatekeeper v3 snapshot) designed for TPU hardware:
+ConstraintTemplate Rego is compiled into vectorized JAX evaluators operating
+on columnar encodings of flattened Kubernetes objects, so that full-cluster
+audit (resources x constraints) runs as batched XLA computations, with a
+Python Rego interpreter serving as the semantics oracle and CPU fallback
+driver (reference parity boundary: the constraint-framework Driver interface,
+/root/reference/vendor/github.com/open-policy-agent/frameworks/constraint/
+pkg/client/drivers/interface.go:21-39).
+"""
+
+__version__ = "0.1.0"

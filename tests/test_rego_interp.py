@@ -1,0 +1,209 @@
+"""Interpreter unit tests: core Rego semantics the library relies on."""
+
+import pytest
+
+from gatekeeper_tpu.rego.interp import Interpreter, RegoError, Undefined
+
+
+def run(src, rule="r", input_doc=None, data_doc=None):
+    it = Interpreter()
+    m = it.add_module("m", src)
+    ctx = it.make_context(input_doc, data_doc)
+    return it.eval_rule_extent(m.package, rule, ctx)
+
+
+def test_complete_rule_and_default():
+    assert run("package p\nr = 7 { true }") == 7
+    assert run("package p\ndefault r = false\nr = true { input.x }") is False
+    assert (
+        run("package p\ndefault r = false\nr = true { input.x }", input_doc={"x": 1})
+        is True
+    )
+
+
+def test_partial_set_and_object():
+    v = run("package p\nr[x] { x := input.xs[_] }", input_doc={"xs": [1, 2, 2]})
+    assert v == frozenset({1, 2})
+    v = run(
+        'package p\nr[k] = val { val := input.m[k] }', input_doc={"m": {"a": 1}}
+    )
+    assert dict(v) == {"a": 1}
+
+
+def test_undefined_propagation():
+    assert run("package p\nr { input.missing.deep }", input_doc={}) is Undefined
+
+
+def test_negation_on_missing_ref_succeeds():
+    assert run("package p\nr = true { not input.missing }", input_doc={}) is True
+    # `not ref == value` keeps the ref inline (OPA RewriteEquals semantics)
+    assert (
+        run(
+            "package p\nr = true { not input.a.b == false }",
+            input_doc={"a": {}},
+        )
+        is True
+    )
+
+
+def test_negation_hoists_call_args():
+    # `not f(input.missing)`: the undefined arg fails the body (OPA
+    # rewriteDynamics semantics), it does NOT make the `not` succeed
+    src = """
+    package p
+    f(x) { x > 0 }
+    r = true { not f(input.missing) }
+    """
+    assert run(src, input_doc={}) is Undefined
+    assert run(src, input_doc={"missing": 0}) is True
+
+
+def test_function_multi_clause_literal_dispatch():
+    src = """
+    package p
+    mult("Ki") = 1024 { true }
+    mult("Mi") = 1048576 { true }
+    r = x { x := mult(input.unit) }
+    """
+    assert run(src, input_doc={"unit": "Mi"}) == 1048576
+    assert run(src, input_doc={"unit": "Zz"}) is Undefined
+
+
+def test_function_false_result():
+    src = """
+    package p
+    chk(x) = res { res := x != 0 }
+    r = true { not chk(input.v) }
+    """
+    assert run(src, input_doc={"v": 0}) is True
+    assert run(src, input_doc={"v": 5}) is Undefined
+
+
+def test_comprehensions_and_set_ops():
+    src = """
+    package p
+    r = missing {
+      provided := {l | input.labels[l]}
+      required := {l | l := input.want[_]}
+      missing := required - provided
+    }
+    """
+    v = run(src, input_doc={"labels": {"a": "1"}, "want": ["a", "b"]})
+    assert v == frozenset({"b"})
+
+
+def test_body_reordering_for_safety():
+    # `key`/`val` are used textually before being bound, as in the
+    # reference's uniqueserviceselector template
+    src = """
+    package p
+    r = flat {
+      selectors := [s | s = concat(":", [key, val]); val = input.sel[key]]
+      flat := concat(",", sort(selectors))
+    }
+    """
+    assert run(src, input_doc={"sel": {"b": "2", "a": "1"}}) == "a:1,b:2"
+
+
+def test_set_membership_pattern_lookup():
+    # indexing a partial set with an object pattern binds its vars
+    src = """
+    package p
+    gv[{"msg": m, "field": f}] { m := "x"; f := "containers" }
+    r[msg] { gv[{"msg": msg, "field": "containers"}] }
+    """
+    assert run(src) == frozenset({"x"})
+
+
+def test_with_modifier_swaps_input_and_data():
+    src = """
+    package p
+    viol[m] { input.bad; m := "bad" }
+    r = n { results := viol with input as {"bad": true}; n := count(results) }
+    s = n { results := viol with input as {"bad": false}; n := count(results) }
+    inv = x { x := data.inventory.k }
+    t = y { y := inv with data.inventory as {"k": 42} }
+    """
+    assert run(src, rule="r", input_doc={}) == 1
+    assert run(src, rule="s", input_doc={}) == 0
+    assert run(src, rule="t", input_doc={}) == 42
+
+
+def test_input_shadowing_via_assign():
+    src = """
+    package p
+    viol[m] { input.bad; m := "bad" }
+    r = n {
+      input := {"bad": true}
+      results := viol with input as input
+      n := count(results)
+    }
+    """
+    assert run(src, input_doc={}) == 1
+
+
+def test_conflicting_complete_rule_errors():
+    with pytest.raises(RegoError):
+        run("package p\nr = 1 { true }\nr = 2 { true }")
+
+
+def test_conflicting_outputs_within_one_rule_error():
+    # multiple body solutions with distinct head values conflict (OPA
+    # eval_conflict_error), they do not silently take the first
+    with pytest.raises(RegoError):
+        run("package p\nr = x { x := input.xs[_] }", input_doc={"xs": [1, 2]})
+    assert (
+        run("package p\nr = x { x := input.xs[_] }", input_doc={"xs": [1, 1]}) == 1
+    )
+
+
+def test_recursion_detection():
+    with pytest.raises(RegoError):
+        run("package p\nr = x { x := r }")
+
+
+def test_recursion_through_with_detected():
+    with pytest.raises(RegoError):
+        run('package p\nr { r with input as {"a": 1} }', input_doc={})
+
+
+def test_strict_type_equality():
+    assert run("package p\nr = true { 1 != true }") is True
+    assert run("package p\nr = true { 1 == 1.0 }") is True
+
+
+def test_arithmetic_and_division():
+    assert run("package p\nr = x { x := 7 / 2 }") == 3.5
+    assert run("package p\nr = x { x := 6 / 2 }") == 3
+    # division by zero is undefined, not an error
+    assert (
+        run("package p\nr = true { x := input.v / 0 }", input_doc={"v": 1})
+        is Undefined
+    )
+
+
+def test_sprintf_formats_like_opa():
+    src = """
+    package p
+    r = m { m := sprintf("labels: %v and <%v> n=%v", [{"a"}, input.s, 3]) }
+    """
+    assert run(src, input_doc={"s": "nginx"}) == 'labels: {"a"} and <nginx> n=3'
+
+
+def test_data_inventory_iteration():
+    src = """
+    package p
+    r[name] {
+      other := data.inventory.namespace[ns][apiver][kind][name]
+      kind == "Ingress"
+    }
+    """
+    data = {
+        "inventory": {
+            "namespace": {
+                "ns1": {"extensions/v1beta1": {"Ingress": {"ing1": {"spec": {}}}}},
+                "ns2": {"v1": {"Service": {"svc1": {}}}},
+            }
+        }
+    }
+    assert run(src, data_doc=data) == frozenset({"ing1"})

@@ -1,0 +1,150 @@
+"""Parser unit tests + parse sweep over the reference policy library."""
+
+import glob
+import os
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.rego import ast as A
+from gatekeeper_tpu.rego.parser import ParseError, parse_module
+
+REFERENCE = "/root/reference"
+
+
+def test_basic_module():
+    m = parse_module(
+        """
+        package foo.bar
+
+        violation[{"msg": msg}] {
+          input.review.object.spec.hostPID
+          msg := "no hostPID"
+        }
+        """
+    )
+    assert m.package == ["foo", "bar"]
+    assert len(m.rules) == 1
+    r = m.rules[0]
+    assert r.head.kind == "set"
+    assert r.head.name == "violation"
+    assert len(r.body) == 2
+
+
+def test_function_rule_with_literal_args():
+    m = parse_module(
+        """
+        package p
+        mem_multiple("Ki") = 1024000 { true }
+        accept_users("RunAsAny", provided_user) {true}
+        """
+    )
+    assert m.rules[0].head.kind == "func"
+    assert isinstance(m.rules[0].head.args[0], A.Scalar)
+    assert m.rules[1].head.kind == "func"
+    assert m.rules[1].head.value.value is True
+
+
+def test_comprehension_vs_union():
+    m = parse_module(
+        """
+        package p
+        a = x { x := {v | v := input.items[_]} }
+        b = y { keys := {1}; y := keys | {2} }
+        c = z { z := [good | repo = input.repos[_]; good = startswith("a", repo)] }
+        """
+    )
+    a_val = m.rules[0].body[0].value
+    assert isinstance(a_val, A.Comprehension) and a_val.kind == "set"
+    b_val = m.rules[1].body[1].value
+    assert isinstance(b_val, A.BinOp) and b_val.op == "|"
+    c_val = m.rules[2].body[0].value
+    assert isinstance(c_val, A.Comprehension) and c_val.kind == "array"
+
+
+def test_partial_object_and_default():
+    m = parse_module(
+        """
+        package p
+        default allow = false
+        obj[k] = v { k := "a"; v := 1 }
+        """
+    )
+    assert m.rules[0].is_default
+    assert m.rules[1].head.kind == "object"
+
+
+def test_destructuring_and_some():
+    m = parse_module(
+        """
+        package p
+        r {
+          some i
+          [prefix, name] := split(input.key, "/")
+          input.arr[i] == name
+        }
+        """
+    )
+    body = m.rules[0].body
+    assert isinstance(body[0], A.SomeDecl)
+    assert isinstance(body[1], A.Assign)
+    assert isinstance(body[1].target, A.ArrayTerm)
+
+
+def test_with_modifier():
+    m = parse_module(
+        """
+        package p
+        r { data.x.violation[v] with input as {"a": 1} with data.inventory as inv }
+        """
+    )
+    expr = m.rules[0].body[0]
+    assert isinstance(expr, A.WithExpr)
+    assert len(expr.mods) == 2
+
+
+def test_multiline_exprs_inside_brackets():
+    m = parse_module(
+        """
+        package p
+        r = out {
+          out := {
+            "a": 1,
+            "b": [2,
+                  3],
+          }
+        }
+        """
+    )
+    assert isinstance(m.rules[0].body[0].value, A.ObjectTerm)
+
+
+def test_parse_error_has_location():
+    with pytest.raises(ParseError):
+        parse_module("package p\nr { := }")
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference not mounted")
+def test_parse_entire_reference_library():
+    files = sorted(glob.glob(f"{REFERENCE}/library/*/*/template.yaml")) + sorted(
+        glob.glob(
+            f"{REFERENCE}/pkg/webhook/testdata/psp-all-violations/psp-templates/*.yaml"
+        )
+    )
+    parsed = 0
+    for f in files:
+        try:
+            docs = list(yaml.safe_load_all(open(f)))
+        except yaml.YAMLError:
+            # containerresourceratios/template.yaml is malformed YAML in the
+            # reference snapshot; the template loader has a lenient fallback
+            continue
+        for d in docs:
+            if not d:
+                continue
+            for t in d.get("spec", {}).get("targets", []):
+                rego = t.get("rego")
+                if rego:
+                    parse_module(rego)
+                    parsed += 1
+    assert parsed >= 25
