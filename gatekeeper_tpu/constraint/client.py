@@ -1,0 +1,392 @@
+"""The constraint-framework Client: engine-agnostic policy orchestration.
+
+Equivalent of vendor/.../frameworks/constraint/pkg/client/client.go:70-838.
+Holds the template/constraint registries, owns the template compile
+pipeline, and fans Review/Audit/AddData calls out to target handlers and
+the Driver. This is the plugin boundary the controllers, webhook, and
+audit manager program against.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ..rego import ast as A
+from . import regocompile
+from .driver import Driver
+from .errors import (
+    InvalidConstraintError,
+    InvalidTemplateError,
+    MissingConstraintError,
+    MissingTemplateError,
+    UnrecognizedConstraintError,
+)
+from .target import K8sValidationTarget, WipeData
+from .templates import (
+    CONSTRAINT_GROUP,
+    CRD,
+    ConstraintTemplate,
+    create_crd,
+    validate_constraint_against_crd,
+)
+from .types import Response, Responses
+
+_TARGET_NAME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9.]*$")
+
+
+@dataclass
+class _TemplateEntry:
+    template: ConstraintTemplate
+    crd: CRD
+    targets: List[str]
+
+
+class Backend:
+    """Driver container; hands out a single Client (client/backend.go:28-60)."""
+
+    def __init__(self, driver: Driver):
+        self.driver = driver
+        self._has_client = False
+
+    def new_client(
+        self,
+        *targets,
+        allowed_data_fields: Sequence[str] = ("inventory",),
+    ) -> "Client":
+        if self._has_client:
+            raise RuntimeError("Backend has already instantiated a client")
+        self._has_client = True
+        return Client(self, list(targets), allowed_data_fields)
+
+
+class Client:
+    def __init__(
+        self,
+        backend: Backend,
+        targets: List[Any],
+        allowed_data_fields: Sequence[str] = ("inventory",),
+    ):
+        if not targets:
+            raise ValueError("No targets registered")
+        self._backend = backend
+        self._driver = backend.driver
+        self._lock = threading.RLock()
+        self.targets: Dict[str, Any] = {}
+        for t in targets:
+            name = t.get_name()
+            if not name or not _TARGET_NAME_RE.match(name):
+                raise ValueError(f"Invalid target name: {name!r}")
+            self.targets[name] = t
+        self.allowed_data_fields = list(allowed_data_fields)
+        # template name -> entry; (group, kind) -> {subpath: constraint}
+        self._templates: Dict[str, _TemplateEntry] = {}
+        self._constraints: Dict[Tuple[str, str], Dict[str, dict]] = {}
+        self._driver.init()
+
+    # -- template pipeline (client.go:240-470) ------------------------------
+
+    def _create_artifacts(
+        self, templ: Union[dict, ConstraintTemplate]
+    ) -> Tuple[ConstraintTemplate, CRD, str, List[A.Module], str]:
+        ct = (
+            templ
+            if isinstance(templ, ConstraintTemplate)
+            else ConstraintTemplate.from_dict(templ)
+        )
+        ct.validate_names()
+        if len(ct.targets) != 1:
+            raise InvalidTemplateError(
+                f"expected exactly 1 item in targets, got {len(ct.targets)}"
+            )
+        spec = ct.targets[0]
+        handler = self.targets.get(spec.target)
+        if handler is None:
+            raise InvalidTemplateError(
+                f"target {spec.target!r} not recognized (known: "
+                f"{sorted(self.targets)})"
+            )
+        crd = create_crd(ct, handler.match_schema())
+        modules = regocompile.compile_template_modules(
+            ct.kind, spec.target, spec.rego, spec.libs, self.allowed_data_fields
+        )
+        prefix = f'templates["{spec.target}"]["{ct.kind}"]'
+        return ct, crd, spec.target, modules, prefix
+
+    def create_crd(self, templ: Union[dict, ConstraintTemplate]) -> CRD:
+        """Validates the full template (including Rego) and returns its CRD
+        (client.go:351-359)."""
+        _, crd, _, _, _ = self._create_artifacts(templ)
+        return crd
+
+    def add_template(self, templ: Union[dict, ConstraintTemplate]) -> Responses:
+        resp = Responses()
+        ct, crd, target, modules, prefix = self._create_artifacts(templ)
+        with self._lock:
+            cached = self._templates.get(ct.name)
+            if cached is not None and _template_equal(cached.template, ct):
+                resp.handled[target] = True
+                return resp
+            self._driver.put_modules(prefix, modules)
+            self._templates[ct.name] = _TemplateEntry(
+                template=ct, crd=crd, targets=[target]
+            )
+            gk = (CONSTRAINT_GROUP, ct.kind)
+            self._constraints.setdefault(gk, {})
+            resp.handled[target] = True
+        return resp
+
+    def remove_template(self, templ: Union[dict, ConstraintTemplate]) -> Responses:
+        resp = Responses()
+        ct = (
+            templ
+            if isinstance(templ, ConstraintTemplate)
+            else ConstraintTemplate.from_dict(templ)
+        )
+        with self._lock:
+            entry = self._templates.get(ct.name)
+            if entry is None:
+                return resp
+            target = entry.targets[0]
+            prefix = f'templates["{target}"]["{entry.crd.kind}"]'
+            self._driver.delete_modules(prefix)
+            gk = (CONSTRAINT_GROUP, entry.crd.kind)
+            for cstr in list(self._constraints.get(gk, {}).values()):
+                self._remove_constraint_locked(cstr)
+            self._constraints.pop(gk, None)
+            self._driver.delete_data(
+                f"/constraints/{target}/cluster/{CONSTRAINT_GROUP}/{entry.crd.kind}"
+            )
+            del self._templates[ct.name]
+            resp.handled[target] = True
+        return resp
+
+    def get_template(self, name_or_templ) -> ConstraintTemplate:
+        name = (
+            name_or_templ
+            if isinstance(name_or_templ, str)
+            else (
+                name_or_templ.name
+                if isinstance(name_or_templ, ConstraintTemplate)
+                else ConstraintTemplate.from_dict(name_or_templ).name
+            )
+        )
+        with self._lock:
+            entry = self._templates.get(name)
+            if entry is None:
+                raise MissingTemplateError(name)
+            return entry.template
+
+    # -- constraints (client.go:473-670) ------------------------------------
+
+    def _get_template_entry(self, constraint: dict) -> _TemplateEntry:
+        kind = constraint.get("kind")
+        if not kind:
+            raise UnrecognizedConstraintError(
+                f"Constraint {_cstr_name(constraint)} has no kind"
+            )
+        group = constraint.get("apiVersion", "").partition("/")[0]
+        if group != CONSTRAINT_GROUP:
+            raise UnrecognizedConstraintError(
+                f"Constraint {_cstr_name(constraint)} has the wrong group: "
+                f"{group!r}"
+            )
+        entry = self._templates.get(kind.lower())
+        if entry is None or entry.crd.kind != kind:
+            raise UnrecognizedConstraintError(kind)
+        return entry
+
+    def add_constraint(self, constraint: dict) -> Responses:
+        resp = Responses()
+        with self._lock:
+            entry = self._get_template_entry(constraint)
+            subpath = _constraint_subpath(constraint)
+            gk = (CONSTRAINT_GROUP, constraint["kind"])
+            cached = self._constraints.get(gk, {}).get(subpath)
+            if cached is not None and _constraint_equal(cached, constraint):
+                for t in entry.targets:
+                    resp.handled[t] = True
+                return resp
+            self._validate_constraint_locked(constraint, entry)
+            for t in entry.targets:
+                self._driver.put_data(
+                    f"/constraints/{t}/{subpath}", constraint
+                )
+                resp.handled[t] = True
+            self._constraints.setdefault(gk, {})[subpath] = copy.deepcopy(
+                constraint
+            )
+        return resp
+
+    def remove_constraint(self, constraint: dict) -> Responses:
+        with self._lock:
+            return self._remove_constraint_locked(constraint)
+
+    def _remove_constraint_locked(self, constraint: dict) -> Responses:
+        resp = Responses()
+        entry = self._get_template_entry(constraint)
+        subpath = _constraint_subpath(constraint)
+        for t in entry.targets:
+            self._driver.delete_data(f"/constraints/{t}/{subpath}")
+            resp.handled[t] = True
+        gk = (CONSTRAINT_GROUP, constraint["kind"])
+        self._constraints.get(gk, {}).pop(subpath, None)
+        return resp
+
+    def get_constraint(self, constraint: dict) -> dict:
+        with self._lock:
+            subpath = _constraint_subpath(constraint)
+            gk = (CONSTRAINT_GROUP, constraint.get("kind", ""))
+            cached = self._constraints.get(gk, {}).get(subpath)
+            if cached is None:
+                raise MissingConstraintError(subpath)
+            return copy.deepcopy(cached)
+
+    def _validate_constraint_locked(
+        self, constraint: dict, entry: _TemplateEntry
+    ) -> None:
+        validate_constraint_against_crd(constraint, entry.crd)
+        for t in entry.targets:
+            self.targets[t].validate_constraint(constraint)
+
+    def validate_constraint(self, constraint: dict) -> None:
+        with self._lock:
+            entry = self._get_template_entry(constraint)
+            self._validate_constraint_locked(constraint, entry)
+
+    # -- data (client.go:91-140) --------------------------------------------
+
+    def add_data(self, data: Any) -> Responses:
+        resp = Responses()
+        for name, handler in self.targets.items():
+            handled, path, processed = handler.process_data(data)
+            if not handled:
+                continue
+            self._driver.put_data(f"/external/{name}/{path}", processed)
+            resp.handled[name] = True
+        return resp
+
+    def remove_data(self, data: Any) -> Responses:
+        resp = Responses()
+        for name, handler in self.targets.items():
+            handled, path, _ = handler.process_data(data)
+            if not handled:
+                continue
+            self._driver.delete_data(f"/external/{name}/{path}")
+            resp.handled[name] = True
+        return resp
+
+    # -- review / audit (client.go:764-836) ---------------------------------
+
+    def review(self, obj: Any, tracing: bool = False) -> Responses:
+        responses = Responses()
+        for name, handler in self.targets.items():
+            handled, review = handler.handle_review(obj)
+            if not handled:
+                continue
+            resp = self._driver.query(
+                f'hooks["{name}"].violation', {"review": review}, tracing
+            )
+            for r in resp.results:
+                handler.handle_violation(r)
+            resp.target = name
+            responses.by_target[name] = resp
+        return responses
+
+    def audit(self, tracing: bool = False) -> Responses:
+        responses = Responses()
+        for name, handler in self.targets.items():
+            resp = self._driver.query(f'hooks["{name}"].audit', None, tracing)
+            for r in resp.results:
+                handler.handle_violation(r)
+            resp.target = name
+            responses.by_target[name] = resp
+        return responses
+
+    # -- maintenance (client.go:725-748, 837) -------------------------------
+
+    def reset(self) -> None:
+        with self._lock:
+            for name in self.targets:
+                self._driver.delete_data(f"/external/{name}")
+                self._driver.delete_data(f"/constraints/{name}")
+            for name, entry in self._templates.items():
+                for t in entry.targets:
+                    self._driver.delete_modules(
+                        f'templates["{t}"]["{entry.crd.kind}"]'
+                    )
+            self._templates = {}
+            self._constraints = {}
+
+    def dump(self) -> str:
+        return self._driver.dump()
+
+    # -- introspection -------------------------------------------------------
+
+    def known_templates(self) -> List[str]:
+        with self._lock:
+            return sorted(self._templates)
+
+    def known_constraint_kinds(self) -> List[str]:
+        with self._lock:
+            return sorted(e.crd.kind for e in self._templates.values())
+
+
+def _cstr_name(constraint: dict) -> str:
+    return ((constraint.get("metadata") or {}).get("name")) or "?"
+
+
+def _constraint_subpath(constraint: dict) -> str:
+    """createConstraintSubPath (client.go:473-486):
+    cluster/<group>/<kind>/<name>."""
+    name = _cstr_name(constraint)
+    if name == "?":
+        raise InvalidConstraintError("Constraint has no name")
+    group = constraint.get("apiVersion", "").partition("/")[0]
+    kind = constraint.get("kind")
+    if not group:
+        raise InvalidConstraintError(
+            f"Empty group for the constraint named {name}"
+        )
+    if not kind:
+        raise InvalidConstraintError(
+            f"Empty kind for the constraint named {name}"
+        )
+    return f"cluster/{group}/{kind}/{name}"
+
+
+def _strip_status(obj: dict) -> dict:
+    out = copy.deepcopy(obj)
+    out.pop("status", None)
+    return out
+
+
+def _template_equal(a: ConstraintTemplate, b: ConstraintTemplate) -> bool:
+    """SemanticEqual (templates): spec comparison, status ignored.
+
+    Raw specs are compared when both templates carry them; directly
+    constructed ConstraintTemplate objects (empty raw) fall back to their
+    substantive fields so updates are never silently dropped.
+    """
+    spec_a = _strip_status(a.raw).get("spec")
+    spec_b = _strip_status(b.raw).get("spec")
+    if spec_a is not None and spec_b is not None:
+        return spec_a == spec_b
+    return (
+        a.kind == b.kind
+        and a.parameters_schema == b.parameters_schema
+        and [(t.target, t.rego, tuple(t.libs)) for t in a.targets]
+        == [(t.target, t.rego, tuple(t.libs)) for t in b.targets]
+    )
+
+
+def _constraint_equal(a: dict, b: dict) -> bool:
+    """constraints.SemanticEqual: spec + enforcement comparison, status
+    ignored."""
+    sa, sb = _strip_status(a), _strip_status(b)
+    return sa.get("spec") == sb.get("spec") and sa.get("metadata", {}).get(
+        "deletionTimestamp"
+    ) == sb.get("metadata", {}).get("deletionTimestamp")

@@ -1,0 +1,494 @@
+"""Native constraint-match semantics oracle.
+
+Implements, in plain Python, exactly the predicate the reference installs as
+an interpreted Rego library (pkg/target/target_template_source.go:6-387,
+mounted via client/client.go:688-700): kind selectors, namespace /
+excludedNamespaces, scope, labelSelector (with the UPDATE old/new OR-match),
+namespaceSelector (resolved from `_unstable.namespace` or the synced
+Namespace cache), and the `autoreject_review` rule.
+
+This single implementation is the behavior contract shared by
+  * the CPU driver (called per-review here), and
+  * the vectorized TPU match kernel (gatekeeper_tpu/engine/match.py), which
+    is differentially tested against this module.
+
+Deliberately replicated quirks of the reference Rego (each covered by a test):
+  * A review with NO namespace field (cluster-scoped admission request) that
+    is not itself a Namespace trivially matches namespaces/excludedNamespaces/
+    namespaceSelector (`always_match_ns_selectors`,
+    target_template_source.go:311-314), and never autorejects: OPA's
+    compiler hoists `input.review.namespace` out of the negated cache
+    lookup in autoreject_review (:17), so an absent namespace fails the
+    whole rule. Definedness of `input.review.kind` is likewise load-bearing
+    through hoisted `is_ns(...)` operands.
+  * matchExpressions `In`/`NotIn` with an empty `values` list never violate
+    (the `count(values) > 0` guards at :190,:198), and unrecognized operators
+    are silently ignored (no match_expression_violated clause applies).
+  * A Namespace-kind review whose `object.metadata.name` is missing (e.g.
+    DELETE reviews carrying only oldObject) fails `get_ns_name` (:301-309),
+    so any constraint with `namespaces`/`excludedNamespaces` does not match.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+_MISSING = object()
+
+
+def get_default(obj: Any, field: str, default: Any) -> Any:
+    """target-lib get_default (target_template_source.go:110-125).
+
+    Null-valued fields count as missing.
+    """
+    if isinstance(obj, dict) and field in obj and obj[field] is not None:
+        return obj[field]
+    return default
+
+
+def hook_get_default(obj: Any, field: str, default: Any) -> Any:
+    """regolib hooks get_default (client/regolib/src.go:76-85).
+
+    Unlike the target lib's, a null value IS returned (only an absent key
+    falls back to the default).
+    """
+    if isinstance(obj, dict) and field in obj:
+        return obj[field]
+    return default
+
+
+def constraint_spec(constraint: Dict[str, Any]) -> Any:
+    return get_default(constraint, "spec", {})
+
+
+def constraint_match(constraint: Dict[str, Any]) -> Any:
+    return get_default(constraint_spec(constraint), "match", {})
+
+
+def enforcement_action(constraint: Dict[str, Any]) -> Any:
+    spec = hook_get_default(constraint, "spec", {})
+    return hook_get_default(spec, "enforcementAction", "deny")
+
+
+def constraint_parameters(constraint: Dict[str, Any]) -> Any:
+    spec = hook_get_default(constraint, "spec", {})
+    return hook_get_default(spec, "parameters", {})
+
+
+# -- review field helpers ---------------------------------------------------
+
+
+def _review_kind(review: Any) -> Any:
+    """input.review.kind as a raw ref: _MISSING when absent.
+
+    Definedness matters: every `is_ns(input.review.kind)` call site has the
+    operand hoisted by OPA's compiler (rewriteDynamics — see
+    gatekeeper_tpu/rego/rewrite.py), so a review with NO kind field fails
+    both `is_ns(...)` and `not is_ns(...)` clauses.
+    """
+    if isinstance(review, dict) and "kind" in review:
+        return review["kind"]
+    return _MISSING
+
+
+def is_ns(review: Any) -> bool:
+    """is_ns(input.review.kind) — group=="" and kind=="Namespace" (:287-290)."""
+    k = _review_kind(review)
+    if not isinstance(k, dict):
+        return False
+    return k.get("group") == "" and k.get("kind") == "Namespace"
+
+
+def _review_namespace(review: Any) -> Any:
+    """input.review.namespace as a raw ref: _MISSING when absent."""
+    if isinstance(review, dict) and "namespace" in review:
+        return review["namespace"]
+    return _MISSING
+
+
+def always_match_ns_selectors(review: Any) -> bool:
+    """Cluster-scoped non-Namespace reviews skip all ns selectors (:311-314).
+
+    Undefined review.kind fails the hoisted `not is_ns(...)` operand, so the
+    rule is undefined (False here).
+    """
+    if _review_kind(review) is _MISSING:
+        return False
+    ns = get_default(review, "namespace", "") if isinstance(review, dict) else ""
+    return (not is_ns(review)) and ns == ""
+
+
+def get_ns_name(review: Any) -> Any:
+    """get_ns_name (:301-309). Returns _MISSING when undefined.
+
+    Both clauses hoist `input.review.kind` into `is_ns`/`not is_ns`, so a
+    missing kind makes the whole partial set undefined.
+    """
+    if _review_kind(review) is _MISSING:
+        return _MISSING
+    if is_ns(review):
+        obj = review.get("object") if isinstance(review, dict) else None
+        if isinstance(obj, dict):
+            meta = obj.get("metadata")
+            if isinstance(meta, dict) and "name" in meta:
+                return meta["name"]
+        return _MISSING
+    return _review_namespace(review)
+
+
+def get_ns(review: Any, ns_cache: Dict[str, Any]) -> Any:
+    """get_ns (:292-299): the namespace OBJECT for the review.
+
+    Prefers `_unstable.namespace`; falls back to the synced cluster cache
+    (data.external.<target>.cluster.v1.Namespace). Returns _MISSING when
+    neither yields a value. Mirrors partial-set semantics: the fallback rule
+    requires `not _unstable.namespace`, which in Rego succeeds when the field
+    is absent OR false.
+    """
+    unstable_ns = _MISSING
+    if isinstance(review, dict):
+        unstable = review.get("_unstable")
+        if isinstance(unstable, dict) and "namespace" in unstable:
+            unstable_ns = unstable["namespace"]
+    if unstable_ns is not _MISSING:
+        if unstable_ns is not False:
+            return unstable_ns
+        # false is falsy in Rego: both get_ns clauses may contribute; prefer
+        # the cache value if present, else the literal false.
+        cached = _cached_ns(review, ns_cache)
+        return cached if cached is not _MISSING else False
+    return _cached_ns(review, ns_cache)
+
+
+def _cached_ns(review: Any, ns_cache: Dict[str, Any]) -> Any:
+    name = _review_namespace(review)
+    if name is _MISSING or not isinstance(ns_cache, dict):
+        return _MISSING
+    if not isinstance(name, str) or name not in ns_cache:
+        return _MISSING
+    return ns_cache[name]
+
+
+# -- label selector logic ---------------------------------------------------
+
+
+def match_expression_violated(
+    operator: Any, labels: Dict[str, Any], key: Any, values: Any
+) -> bool:
+    """match_expression_violated (:184-210).
+
+    has_field counts any present key — null included, since null is truthy
+    in Rego (`object[field]` binds and succeeds).
+    """
+    has_key = isinstance(labels, dict) and key in labels
+    vals = values if isinstance(values, list) else []
+    if operator == "In":
+        if not has_key:
+            return True
+        return len(vals) > 0 and labels[key] not in vals
+    if operator == "NotIn":
+        return has_key and len(vals) > 0 and labels[key] in vals
+    if operator == "Exists":
+        return not has_key
+    if operator == "DoesNotExist":
+        return has_key
+    return False  # unknown operators contribute no violation
+
+
+def matches_label_selector(selector: Any, labels: Any) -> bool:
+    """matches_label_selector (:213-230)."""
+    if not isinstance(labels, dict):
+        labels = {}
+    match_labels = get_default(selector, "matchLabels", {})
+    if isinstance(match_labels, dict):
+        for k, v in match_labels.items():
+            if k not in labels or labels[k] != v:
+                return False
+    elif match_labels not in ([], ""):
+        # non-object matchLabels: the satisfied-count comprehension yields
+        # nothing while count(matchLabels) > 0 (or errors), so no match
+        return False
+    match_exprs = get_default(selector, "matchExpressions", [])
+    if isinstance(match_exprs, list):
+        for expr in match_exprs:
+            if not isinstance(expr, dict):
+                # expr["operator"] undefined -> comprehension body fails for
+                # this element -> no violation recorded
+                continue
+            if "operator" not in expr or "key" not in expr:
+                continue
+            if match_expression_violated(
+                expr["operator"],
+                labels,
+                expr["key"],
+                get_default(expr, "values", []),
+            ):
+                return False
+    return True
+
+
+def _object_labels(obj: Any) -> Dict[str, Any]:
+    metadata = get_default(obj, "metadata", {})
+    labels = get_default(metadata, "labels", {})
+    return labels if isinstance(labels, dict) else {}
+
+
+def _review_obj(review: Any, field: str) -> Any:
+    """get_default(review, field, {}) compared against {} (:233-281)."""
+    val = get_default(review, field, {})
+    return val
+
+
+def any_labelselector_match(selector: Any, review: Any) -> bool:
+    """any_labelselector_match (:233-281): OR over object/oldObject labels."""
+    obj = _review_obj(review, "object")
+    old = _review_obj(review, "oldObject")
+    obj_absent = obj == {}
+    old_absent = old == {}
+    if old_absent and not obj_absent:
+        return matches_label_selector(selector, _object_labels(obj))
+    if not old_absent and obj_absent:
+        return matches_label_selector(selector, _object_labels(old))
+    if not old_absent and not obj_absent:
+        return matches_label_selector(
+            selector, _object_labels(obj)
+        ) or matches_label_selector(selector, _object_labels(old))
+    return matches_label_selector(selector, {})
+
+
+# -- the five match dimensions ----------------------------------------------
+
+
+def any_kind_selector_matches(match: Any, review: Any) -> bool:
+    """Kind selector (:131-156)."""
+    kind_selectors = get_default(
+        match, "kinds", [{"apiGroups": ["*"], "kinds": ["*"]}]
+    )
+    if not isinstance(kind_selectors, list):
+        return False
+    k = _review_kind(review)
+    if not isinstance(k, dict):
+        k = {}
+    group = k.get("group", _MISSING)
+    kind = k.get("kind", _MISSING)
+    for ks in kind_selectors:
+        if not isinstance(ks, dict):
+            continue
+        groups = ks.get("apiGroups")
+        kinds = ks.get("kinds")
+        if not isinstance(groups, list) or not isinstance(kinds, list):
+            # ks.apiGroups[_] over a missing/non-array field is undefined
+            continue
+        group_ok = "*" in groups or (group is not _MISSING and group in groups)
+        kind_ok = "*" in kinds or (kind is not _MISSING and kind in kinds)
+        if group_ok and kind_ok:
+            return True
+    return False
+
+
+def matches_scope(match: Any, review: Any) -> bool:
+    """Scope selector (:162-178).
+
+    A present-but-null scope passes has_field (null is truthy in Rego) yet
+    equals none of "*"/"Namespaced"/"Cluster", so nothing matches.
+    """
+    if not _has_field(match, "scope"):
+        return True
+    scope = match["scope"]
+    if scope == "*":
+        return True
+    ns = get_default(review, "namespace", "")
+    if scope == "Namespaced":
+        return ns != ""
+    if scope == "Cluster":
+        return ns == ""
+    return False
+
+
+def matches_namespaces(match: Any, review: Any) -> bool:
+    """namespaces (:316-332)."""
+    if not _has_field(match, "namespaces"):
+        return True
+    if always_match_ns_selectors(review):
+        return True
+    ns = get_ns_name(review)
+    if ns is _MISSING:
+        return False
+    nss = match["namespaces"]
+    return isinstance(nss, list) and ns in nss
+
+
+def does_not_match_excludednamespaces(match: Any, review: Any) -> bool:
+    """excludedNamespaces (:334-350)."""
+    if not _has_field(match, "excludedNamespaces"):
+        return True
+    if always_match_ns_selectors(review):
+        return True
+    ns = get_ns_name(review)
+    if ns is _MISSING:
+        return False
+    nss = match["excludedNamespaces"]
+    if not isinstance(nss, list):
+        # `{n | n = match.excludedNamespaces[_]}` over a non-array is the
+        # empty set, so ns is trivially not excluded
+        return True
+    return ns not in nss
+
+
+def matches_nsselector(
+    match: Any, review: Any, ns_cache: Dict[str, Any]
+) -> bool:
+    """namespaceSelector (:352-386)."""
+    if not _has_field(match, "namespaceSelector"):
+        return True
+    if always_match_ns_selectors(review):
+        return True
+    if _review_kind(review) is _MISSING:
+        # both remaining clauses hoist input.review.kind into is_ns
+        return False
+    if is_ns(review):
+        return any_labelselector_match(
+            get_default(match, "namespaceSelector", {}), review
+        )
+    ns = get_ns(review, ns_cache)
+    if ns is _MISSING:
+        return False
+    metadata = get_default(ns, "metadata", {})
+    nslabels = get_default(metadata, "labels", {})
+    selector = get_default(match, "namespaceSelector", {})
+    return matches_label_selector(selector, nslabels)
+
+
+def _has_field(obj: Any, field: str) -> bool:
+    """has_field (:92-105): any present key counts — false via the explicit
+    `object[field] == false` clause, null because null is truthy in Rego."""
+    return isinstance(obj, dict) and field in obj
+
+
+def matches_constraint(
+    constraint: Dict[str, Any], review: Any, ns_cache: Dict[str, Any]
+) -> bool:
+    """matching_constraints body (:27-44) for a single constraint."""
+    match = constraint_match(constraint)
+    if not any_kind_selector_matches(match, review):
+        return False
+    if not matches_namespaces(match, review):
+        return False
+    if not does_not_match_excludednamespaces(match, review):
+        return False
+    if not matches_nsselector(match, review, ns_cache):
+        return False
+    if not matches_scope(match, review):
+        return False
+    label_selector = get_default(match, "labelSelector", {})
+    return any_labelselector_match(label_selector, review)
+
+
+def matching_constraints(
+    constraints: Iterable[Dict[str, Any]],
+    review: Any,
+    ns_cache: Dict[str, Any],
+) -> List[Dict[str, Any]]:
+    return [c for c in constraints if matches_constraint(c, review, ns_cache)]
+
+
+# -- autoreject -------------------------------------------------------------
+
+
+def autoreject(
+    constraint: Dict[str, Any], review: Any, ns_cache: Dict[str, Any]
+) -> bool:
+    """autoreject_review (:12-25) for a single constraint.
+
+    Fires when the constraint needs a namespaceSelector but the review's
+    namespace is neither attached (`_unstable.namespace`) nor cached, and the
+    namespace field is present and not the empty string. Presence is
+    required because OPA hoists `input.review.namespace` out of the negated
+    cache lookup (`not DataRoot...Namespace[input.review.namespace]`), so an
+    absent field fails the whole rule — cluster-scoped reviews never
+    autoreject.
+    """
+    match = constraint_match(constraint)
+    if not _has_field(match, "namespaceSelector"):
+        return False
+    ns_name = _review_namespace(review)
+    if ns_name is _MISSING:
+        return False
+    # not DataRoot.cluster.v1.Namespace[input.review.namespace]
+    if (
+        isinstance(ns_name, str)
+        and isinstance(ns_cache, dict)
+        and ns_name in ns_cache
+    ):
+        return False
+    # not input.review._unstable.namespace — succeeds only when the path is
+    # absent or the value is false (null/0/"" are truthy in Rego)
+    if isinstance(review, dict):
+        unstable = review.get("_unstable")
+        if isinstance(unstable, dict):
+            val = unstable.get("namespace", _MISSING)
+            if val is not _MISSING and val is not False:
+                return False
+    # not input.review.namespace == ""  (undefined namespace -> succeeds)
+    if ns_name == "":
+        return False
+    return True
+
+
+# -- audit cross-join -------------------------------------------------------
+
+
+def make_group_version(api_version: str) -> Tuple[str, str]:
+    """make_group_version (:74-83). Keys are url.PathEscape()d groupVersions
+    (pkg/target/target.go:73), so non-core groups arrive as e.g. "apps%2Fv1"
+    and deliberately fail the "/" split, yielding group "" — a reference
+    quirk preserved for audit-from-cache parity."""
+    if "/" in api_version:
+        group, version = api_version.split("/", 1)
+        return group, version
+    return "", api_version
+
+
+def make_review(
+    obj: Any, api_version: str, kind: str, name: str, namespace: Optional[str] = None
+) -> Dict[str, Any]:
+    """make_review (:61-68) + add_field namespace for namespaced objects."""
+    group, version = make_group_version(api_version)
+    review: Dict[str, Any] = {
+        "kind": {"group": group, "version": version, "kind": kind},
+        "name": name,
+        "object": obj,
+    }
+    if namespace is not None:
+        review["namespace"] = namespace
+    return review
+
+
+def iter_cached_reviews(external: Any):
+    """matching_reviews_and_constraints data walk (:47-59): yields a review
+    per cached object, namespaced tree first, then cluster tree."""
+    if not isinstance(external, dict):
+        return
+    namespaces = external.get("namespace")
+    if isinstance(namespaces, dict):
+        for ns_name, by_gv in sorted(namespaces.items()):
+            if not isinstance(by_gv, dict):
+                continue
+            for gv, by_kind in sorted(by_gv.items()):
+                if not isinstance(by_kind, dict):
+                    continue
+                for kind, by_name in sorted(by_kind.items()):
+                    if not isinstance(by_name, dict):
+                        continue
+                    for name, obj in sorted(by_name.items()):
+                        yield make_review(obj, gv, kind, name, namespace=ns_name)
+    cluster = external.get("cluster")
+    if isinstance(cluster, dict):
+        for gv, by_kind in sorted(cluster.items()):
+            if not isinstance(by_kind, dict):
+                continue
+            for kind, by_name in sorted(by_kind.items()):
+                if not isinstance(by_name, dict):
+                    continue
+                for name, obj in sorted(by_name.items()):
+                    yield make_review(obj, gv, kind, name)

@@ -1,0 +1,268 @@
+"""K8sValidationTarget: the Kubernetes-specific data model plugin.
+
+TPU-native equivalent of /root/reference/pkg/target/target.go:23-354. The
+target handler owns: routing synced cluster objects into the driver's data
+tree, normalizing the three review input shapes into a gkReview, extracting
+the violating resource from results, and the constraint `spec.match` schema.
+
+The Rego matching library the reference pairs with this handler
+(target_template_source.go) lives natively in match.py instead.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from .errors import InvalidConstraintError
+from .types import Result
+
+
+class WipeData:
+    """Sentinel: deletes the target's whole data subtree (target.go:37-41)."""
+
+
+@dataclass
+class AdmissionRequest:
+    """A typed wrapper marking a dict as an AdmissionRequest review."""
+
+    request: Dict[str, Any]
+
+
+@dataclass
+class AugmentedReview:
+    """AdmissionRequest + its (optional) Namespace object (target.go:43-46)."""
+
+    admission_request: Dict[str, Any]
+    namespace: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class AugmentedUnstructured:
+    """A cluster object + its Namespace, used by audit (target.go:53-56)."""
+
+    object: Dict[str, Any]
+    namespace: Optional[Dict[str, Any]] = None
+
+
+def _gvk_of(obj: Dict[str, Any]) -> Tuple[str, str, str]:
+    api_version = obj.get("apiVersion", "") or ""
+    kind = obj.get("kind", "") or ""
+    if "/" in api_version:
+        group, version = api_version.split("/", 1)
+    else:
+        group, version = "", api_version
+    return group, version, kind
+
+
+def _meta(obj: Dict[str, Any], key: str) -> str:
+    metadata = obj.get("metadata")
+    if isinstance(metadata, dict):
+        val = metadata.get(key)
+        if isinstance(val, str):
+            return val
+    return ""
+
+
+def _unstructured_to_admission_request(obj: Dict[str, Any]) -> Dict[str, Any]:
+    """unstructuredToAdmissionRequest (target.go:144-163): kind + object +
+    name only — namespace deliberately NOT set."""
+    group, version, kind = _gvk_of(obj)
+    return {
+        "kind": {"group": group, "version": version, "kind": kind},
+        "object": obj,
+        "name": _meta(obj, "name"),
+    }
+
+
+class K8sValidationTarget:
+    """client.TargetHandler implementation for Kubernetes admission data."""
+
+    def get_name(self) -> str:
+        return "admission.k8s.gatekeeper.sh"
+
+    # -- data ingestion (target.go:62-89) ----------------------------------
+
+    def process_data(self, obj: Any) -> Tuple[bool, str, Any]:
+        """Returns (handled, relative path, processed data).
+
+        Paths: cluster/<escaped groupVersion>/<kind>/<name> or
+        namespace/<ns>/<escaped groupVersion>/<kind>/<name>; the
+        groupVersion is url-path-escaped exactly as the reference does
+        (target.go:73-75), so "apps/v1" becomes "apps%2Fv1".
+        """
+        if isinstance(obj, WipeData) or obj is WipeData:
+            return True, "", None
+        if not isinstance(obj, dict):
+            return False, "", None
+        group, version, kind = _gvk_of(obj)
+        name = _meta(obj, "name")
+        if version == "":
+            raise ValueError(f"resource {name} has no version")
+        if kind == "":
+            raise ValueError(f"resource {name} has no kind")
+        gv = f"{group}/{version}" if group else version
+        gv = urllib.parse.quote(gv, safe="$&+,:;=?@!*'()~")  # url.PathEscape
+        namespace = _meta(obj, "namespace")
+        if namespace == "":
+            return True, f"cluster/{gv}/{kind}/{name}", obj
+        return True, f"namespace/{namespace}/{gv}/{kind}/{name}", obj
+
+    # -- review normalization (target.go:91-142) ---------------------------
+
+    def handle_review(self, obj: Any) -> Tuple[bool, Any]:
+        """Normalizes review inputs into the gkReview dict shape."""
+        if isinstance(obj, AdmissionRequest):
+            return True, obj.request
+        if isinstance(obj, AugmentedReview):
+            review = dict(obj.admission_request)
+            review["_unstable"] = (
+                {"namespace": obj.namespace} if obj.namespace is not None else {}
+            )
+            return True, review
+        if isinstance(obj, AugmentedUnstructured):
+            review = _unstructured_to_admission_request(obj.object)
+            review["_unstable"] = (
+                {"namespace": obj.namespace} if obj.namespace is not None else {}
+            )
+            if obj.namespace is not None:
+                review["namespace"] = _meta(obj.namespace, "name")
+            return True, review
+        if isinstance(obj, dict):
+            # raw dicts are treated as unstructured cluster objects, matching
+            # the reference's unstructured.Unstructured case (target.go:113)
+            return True, _unstructured_to_admission_request(obj)
+        return False, None
+
+    # -- violation post-processing (target.go:193-244) ---------------------
+
+    def handle_violation(self, result: Result) -> None:
+        review = result.review
+        if not isinstance(review, dict):
+            raise ValueError(f"could not cast review as map: {review!r}")
+        kind_info = review.get("kind")
+        if not isinstance(kind_info, dict):
+            raise ValueError("review[kind] does not exist")
+        fields = {}
+        for k in ("group", "version", "kind"):
+            v = kind_info.get(k)
+            if not isinstance(v, str):
+                raise ValueError(f"review[kind][{k}] is not a string: {v!r}")
+            fields[k] = v
+        api_version = (
+            fields["version"]
+            if fields["group"] == ""
+            else f"{fields['group']}/{fields['version']}"
+        )
+        obj = review.get("object")
+        if not isinstance(obj, dict):
+            obj = review.get("oldObject")
+        if not isinstance(obj, dict):
+            raise ValueError("no object or oldObject returned in review")
+        resource = json.loads(json.dumps(obj))
+        resource["apiVersion"] = api_version
+        resource["kind"] = fields["kind"]
+        result.resource = resource
+
+    # -- constraint spec.match schema (target.go:246-318) ------------------
+
+    def match_schema(self) -> Dict[str, Any]:
+        string_list = {"type": "array", "items": {"type": "string"}}
+        label_selector = {
+            "type": "object",
+            "properties": {
+                "matchExpressions": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "properties": {
+                            "key": {"type": "string"},
+                            "operator": {
+                                "type": "string",
+                                "enum": ["In", "NotIn", "Exists", "DoesNotExist"],
+                            },
+                            "values": string_list,
+                        },
+                    },
+                }
+            },
+        }
+        return {
+            "type": "object",
+            "properties": {
+                "kinds": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "properties": {
+                            "apiGroups": string_list,
+                            "kinds": string_list,
+                        },
+                    },
+                },
+                "namespaces": string_list,
+                "excludedNamespaces": string_list,
+                "labelSelector": label_selector,
+                "namespaceSelector": label_selector,
+                "scope": {
+                    "type": "string",
+                    "enum": ["*", "Cluster", "Namespaced"],
+                },
+            },
+        }
+
+    # -- constraint validation (target.go:320-354) -------------------------
+
+    def validate_constraint(self, constraint: Dict[str, Any]) -> None:
+        spec = constraint.get("spec")
+        match = spec.get("match") if isinstance(spec, dict) else None
+        if not isinstance(match, dict):
+            return
+        for sel_field in ("labelSelector", "namespaceSelector"):
+            selector = match.get(sel_field)
+            if isinstance(selector, dict):
+                _validate_label_selector(selector, sel_field)
+
+
+_LABEL_VALUE_RE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+
+
+def _validate_label_selector(selector: Dict[str, Any], path: str) -> None:
+    """Mirrors metav1 validation.ValidateLabelSelector: operator-specific
+    values rules and label-value syntax for In/NotIn values."""
+    exprs = selector.get("matchExpressions")
+    if not isinstance(exprs, list):
+        return
+    for i, expr in enumerate(exprs):
+        if not isinstance(expr, dict):
+            raise InvalidConstraintError(
+                f"{path}.matchExpressions[{i}]: must be an object"
+            )
+        op = expr.get("operator")
+        values = expr.get("values") or []
+        if op in ("In", "NotIn"):
+            if not values:
+                raise InvalidConstraintError(
+                    f"{path}.matchExpressions[{i}].values: must be specified "
+                    f"when `operator` is 'In' or 'NotIn'"
+                )
+        elif op in ("Exists", "DoesNotExist"):
+            if values:
+                raise InvalidConstraintError(
+                    f"{path}.matchExpressions[{i}].values: may not be "
+                    f"specified when `operator` is 'Exists' or 'DoesNotExist'"
+                )
+        else:
+            raise InvalidConstraintError(
+                f"{path}.matchExpressions[{i}].operator: not a valid selector "
+                f"operator: {op!r}"
+            )
+        for v in values:
+            if not isinstance(v, str) or len(v) > 63 or not _LABEL_VALUE_RE.match(v):
+                raise InvalidConstraintError(
+                    f"{path}.matchExpressions[{i}].values: invalid label "
+                    f"value: {v!r}"
+                )

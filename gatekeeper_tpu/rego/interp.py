@@ -309,7 +309,7 @@ def _call_function(
     ctx: Context, mod: A.Module, node: PkgNode, name: str, args: List[Any]
 ) -> Any:
     """Call a user function; returns value or Undefined."""
-    fkey = (_rule_key(mod, name), tuple(args))
+    fkey = (id(node), name, tuple(args))
     if fkey in ctx.fn_cache:
         return ctx.fn_cache[fkey]
     rules = node.rules.get(name, [])
@@ -834,19 +834,43 @@ def _materialize_cursor(ctx: Context, cur: DataCursor) -> Any:
     return Obj({})
 
 
+def _resolve_fn_node(
+    ctx: Context, mod: A.Module, name: str
+) -> Tuple[Optional[PkgNode], str]:
+    """Resolve a call name to its package node + local rule name.
+
+    Bare names resolve in the calling module; dotted `data.…` names resolve
+    through the package tree (cross-package function calls, used by
+    ConstraintTemplate libs after rewriting)."""
+    node = _module_node(ctx, mod)
+    if (
+        name in node.rules
+        and node.rules[name]
+        and node.rules[name][0].head.kind == "func"
+    ):
+        return node, name
+    if name.startswith("data."):
+        parts = name.split(".")[1:]
+        fn_node = ctx.interp._pkg_node(parts[:-1], create=False)
+        local = parts[-1]
+        if (
+            fn_node is not None
+            and local in fn_node.rules
+            and fn_node.rules[local]
+            and fn_node.rules[local][0].head.kind == "func"
+        ):
+            return fn_node, local
+    return None, name
+
+
 def _eval_call(
     ctx: Context, mod: A.Module, call: A.Call, env: Env
 ) -> Iterator[Tuple[Any, Env]]:
     name = call.name
-    node = _module_node(ctx, mod)
-    is_user_fn = (
-        name in node.rules
-        and node.rules[name]
-        and node.rules[name][0].head.kind == "func"
-    )
+    fn_node, local_name = _resolve_fn_node(ctx, mod, name)
     for args, env2 in _eval_terms(ctx, mod, call.args, env):
-        if is_user_fn:
-            v = _call_function(ctx, mod, node, name, args)
+        if fn_node is not None:
+            v = _call_function(ctx, mod, fn_node, local_name, args)
             if v is not Undefined:
                 yield v, env2
             continue

@@ -1,0 +1,529 @@
+"""Client/driver conformance tests.
+
+Behavior-parity battery modeled on the reference's driver-parameterized
+e2e suite (vendor/.../frameworks/constraint/pkg/client/e2e_tests.go) and
+probe client (probe_client.go:15): template/constraint lifecycle, review
+and audit paths, enforcement actions, libs, extern validation, schema
+validation, data wipe, and the namespace-cache autoreject rule.
+"""
+
+import pytest
+
+from gatekeeper_tpu.constraint import (
+    AdmissionRequest,
+    AugmentedReview,
+    AugmentedUnstructured,
+    Backend,
+    Client,
+    InvalidConstraintError,
+    InvalidTemplateError,
+    K8sValidationTarget,
+    RegoDriver,
+    UnrecognizedConstraintError,
+    WipeData,
+)
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+
+def make_template(kind, rego, libs=(), params_schema=None):
+    spec_crd = {"spec": {"names": {"kind": kind}}}
+    if params_schema is not None:
+        spec_crd["spec"]["validation"] = {"openAPIV3Schema": params_schema}
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": spec_crd,
+            "targets": [
+                {"target": TARGET, "rego": rego, "libs": list(libs)}
+            ],
+        },
+    }
+
+
+def make_constraint(kind, name, params=None, enforcement=None, match=None):
+    spec = {}
+    if params is not None:
+        spec["parameters"] = params
+    if enforcement is not None:
+        spec["enforcementAction"] = enforcement
+    if match is not None:
+        spec["match"] = match
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def pod(name="mypod", namespace="default", labels=None):
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"containers": [{"name": "main", "image": "nginx"}]},
+    }
+    if labels:
+        obj["metadata"]["labels"] = labels
+    return obj
+
+
+DENY_ALL = """package foo
+violation[{"msg": "DENIED", "details": {}}] {
+    "always" == "always"
+}
+"""
+
+DENY_PARAM = """package foo
+violation[{"msg": msg}] {
+    input.parameters.expected == input.review.object.metadata.name
+    msg := sprintf("matched %v", [input.review.object.metadata.name])
+}
+"""
+
+
+@pytest.fixture
+def client():
+    backend = Backend(RegoDriver())
+    return backend.new_client(K8sValidationTarget())
+
+
+def test_add_template_and_review_deny_all(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "deny-everything"))
+    rsps = client.review(pod())
+    results = rsps.results()
+    assert len(results) == 1
+    r = results[0]
+    assert r.msg == "DENIED"
+    assert r.enforcement_action == "deny"
+    assert r.constraint["metadata"]["name"] == "deny-everything"
+    assert r.resource["kind"] == "Pod"
+    assert r.resource["apiVersion"] == "v1"
+
+
+def test_review_without_constraints_allows(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    assert client.review(pod()).results() == []
+
+
+def test_dryrun_enforcement_action_passthrough(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(
+        make_constraint("DenyAll", "dry", enforcement="dryrun")
+    )
+    results = client.review(pod()).results()
+    assert len(results) == 1
+    assert results[0].enforcement_action == "dryrun"
+
+
+def test_parameters_flow_into_template(client):
+    client.add_template(
+        make_template(
+            "NameMatch",
+            DENY_PARAM,
+            params_schema={"properties": {"expected": {"type": "string"}}},
+        )
+    )
+    client.add_constraint(
+        make_constraint("NameMatch", "check", params={"expected": "mypod"})
+    )
+    results = client.review(pod(name="mypod")).results()
+    assert len(results) == 1
+    assert results[0].msg == "matched mypod"
+    assert client.review(pod(name="other")).results() == []
+
+
+def test_template_with_lib(client):
+    rego = """package foo
+violation[{"msg": msg}] {
+    data.lib.helpers.is_bad(input.review.object.metadata.name)
+    msg := "BAD NAME"
+}
+"""
+    lib = """package lib.helpers
+is_bad(name) {
+    name == "badpod"
+}
+"""
+    client.add_template(make_template("LibDeny", rego, libs=[lib]))
+    client.add_constraint(make_constraint("LibDeny", "libc"))
+    assert client.review(pod(name="badpod")).results()[0].msg == "BAD NAME"
+    assert client.review(pod(name="goodpod")).results() == []
+
+
+def test_lib_package_must_be_under_lib(client):
+    lib = "package notlib\nx := 1\n"
+    with pytest.raises(InvalidTemplateError):
+        client.add_template(make_template("BadLib", DENY_ALL, libs=[lib]))
+
+
+def test_template_missing_violation_rule(client):
+    rego = "package foo\nsomething := true\n"
+    with pytest.raises(InvalidTemplateError):
+        client.add_template(make_template("NoViolation", rego))
+
+
+def test_template_violation_wrong_arity(client):
+    rego = "package foo\nviolation := true\n"
+    with pytest.raises(InvalidTemplateError):
+        client.add_template(make_template("BadArity", rego))
+
+
+def test_template_invalid_extern(client):
+    rego = """package foo
+violation[{"msg": "x"}] {
+    data.forbidden.thing == 1
+}
+"""
+    with pytest.raises(InvalidTemplateError):
+        client.add_template(make_template("BadExtern", rego))
+
+
+def test_template_inventory_extern_allowed(client):
+    rego = """package foo
+violation[{"msg": "found"}] {
+    data.inventory.cluster["v1"]["Namespace"][_]
+}
+"""
+    client.add_template(make_template("InvOk", rego))
+
+
+def test_template_name_mismatch(client):
+    t = make_template("DenyAll", DENY_ALL)
+    t["metadata"]["name"] = "wrongname"
+    with pytest.raises(InvalidTemplateError):
+        client.add_template(t)
+
+
+def test_template_empty_rego(client):
+    with pytest.raises(InvalidTemplateError):
+        client.add_template(make_template("Empty", ""))
+
+
+def test_template_no_targets(client):
+    t = make_template("DenyAll", DENY_ALL)
+    t["spec"]["targets"] = []
+    with pytest.raises(InvalidTemplateError):
+        client.add_template(t)
+
+
+def test_constraint_without_template_rejected(client):
+    with pytest.raises(UnrecognizedConstraintError):
+        client.add_constraint(make_constraint("Nonexistent", "c1"))
+
+
+def test_constraint_wrong_group_rejected(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    c = make_constraint("DenyAll", "c1")
+    c["apiVersion"] = "wrong.group/v1beta1"
+    with pytest.raises(UnrecognizedConstraintError):
+        client.add_constraint(c)
+
+
+def test_constraint_schema_validation(client):
+    client.add_template(
+        make_template(
+            "NameMatch",
+            DENY_PARAM,
+            params_schema={"properties": {"expected": {"type": "string"}}},
+        )
+    )
+    with pytest.raises(InvalidConstraintError):
+        client.add_constraint(
+            make_constraint("NameMatch", "bad", params={"expected": 42})
+        )
+
+
+def test_constraint_bad_match_expression_operator(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    with pytest.raises(InvalidConstraintError):
+        client.add_constraint(
+            make_constraint(
+                "DenyAll",
+                "badop",
+                match={
+                    "labelSelector": {
+                        "matchExpressions": [
+                            {"key": "a", "operator": "Frobnicate"}
+                        ]
+                    }
+                },
+            )
+        )
+
+
+def test_constraint_in_operator_requires_values(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    with pytest.raises(InvalidConstraintError):
+        client.add_constraint(
+            make_constraint(
+                "DenyAll",
+                "noval",
+                match={
+                    "labelSelector": {
+                        "matchExpressions": [{"key": "a", "operator": "In"}]
+                    }
+                },
+            )
+        )
+
+
+def test_remove_constraint(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "c1"))
+    assert len(client.review(pod()).results()) == 1
+    client.remove_constraint(make_constraint("DenyAll", "c1"))
+    assert client.review(pod()).results() == []
+
+
+def test_remove_template_removes_constraints(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "c1"))
+    client.remove_template(make_template("DenyAll", DENY_ALL))
+    assert client.review(pod()).results() == []
+    # constraints for removed templates are unrecognized again
+    with pytest.raises(UnrecognizedConstraintError):
+        client.add_constraint(make_constraint("DenyAll", "c2"))
+
+
+def test_audit_over_cached_data(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "deny-everything"))
+    for i in range(3):
+        client.add_data(pod(name=f"pod-{i}"))
+    results = client.audit().results()
+    assert len(results) == 3
+    assert {r.resource["metadata"]["name"] for r in results} == {
+        "pod-0",
+        "pod-1",
+        "pod-2",
+    }
+    # audit reviews carry the synthesized review shape with namespace
+    assert all(r.review["namespace"] == "default" for r in results)
+
+
+def test_audit_respects_match(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(
+        make_constraint("DenyAll", "prod-only", match={"namespaces": ["prod"]})
+    )
+    client.add_data(pod(name="a", namespace="prod"))
+    client.add_data(pod(name="b", namespace="dev"))
+    results = client.audit().results()
+    assert len(results) == 1
+    assert results[0].resource["metadata"]["name"] == "a"
+
+
+def test_remove_data(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "c"))
+    p = pod(name="a")
+    client.add_data(p)
+    assert len(client.audit().results()) == 1
+    client.remove_data(p)
+    assert client.audit().results() == []
+
+
+def test_wipe_data(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "c"))
+    for i in range(5):
+        client.add_data(pod(name=f"p{i}"))
+    client.remove_data(WipeData())
+    assert client.audit().results() == []
+
+
+def test_inventory_referential_policy(client):
+    """data.inventory joins (the uniqueingresshost pattern)."""
+    rego = """package foo
+violation[{"msg": msg}] {
+    other := data.inventory.namespace[ns][_]["Pod"][name]
+    other.metadata.labels.app == input.review.object.metadata.labels.app
+    name != input.review.object.metadata.name
+    msg := sprintf("duplicate app label with %v", [name])
+}
+"""
+    client.add_template(make_template("UniqueApp", rego))
+    client.add_constraint(make_constraint("UniqueApp", "unique"))
+    client.add_data(pod(name="existing", labels={"app": "web"}))
+    results = client.review(pod(name="incoming", labels={"app": "web"})).results()
+    assert len(results) == 1
+    assert "existing" in results[0].msg
+    assert (
+        client.review(pod(name="incoming", labels={"app": "other"})).results()
+        == []
+    )
+
+
+def test_autoreject_uncached_namespace(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(
+        make_constraint(
+            "DenyAll",
+            "needs-ns",
+            match={"namespaceSelector": {"matchLabels": {"env": "prod"}}},
+        )
+    )
+    # a raw unstructured review carries no namespace field, so it trivially
+    # matches and is NOT autorejected (reference parity: see match-oracle
+    # tests); an AdmissionRequest-shaped review with a namespace IS.
+    assert client.review(pod(namespace="nowhere")).results()[0].msg == "DENIED"
+    req = AdmissionRequest(
+        {
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "name": "mypod",
+            "namespace": "nowhere",
+            "object": pod(namespace="nowhere"),
+        }
+    )
+    results = client.review(req).results()
+    assert len(results) == 1
+    assert results[0].msg == "Namespace is not cached in OPA."
+    # with the namespace attached (webhook path), no autoreject
+    req = {
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": "mypod",
+        "namespace": "nowhere",
+        "object": pod(namespace="nowhere"),
+    }
+    aug = AugmentedReview(
+        admission_request=req,
+        namespace={
+            "metadata": {"name": "nowhere", "labels": {"env": "prod"}}
+        },
+    )
+    results = client.review(aug).results()
+    assert len(results) == 1
+    assert results[0].msg == "DENIED"
+
+
+def test_augmented_unstructured_review(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(
+        make_constraint(
+            "DenyAll",
+            "nssel",
+            match={"namespaceSelector": {"matchLabels": {"env": "prod"}}},
+        )
+    )
+    aug = AugmentedUnstructured(
+        object=pod(namespace="prod"),
+        namespace={"metadata": {"name": "prod", "labels": {"env": "prod"}}},
+    )
+    assert client.review(aug).results()[0].msg == "DENIED"
+    aug_dev = AugmentedUnstructured(
+        object=pod(namespace="dev"),
+        namespace={"metadata": {"name": "dev", "labels": {"env": "dev"}}},
+    )
+    assert client.review(aug_dev).results() == []
+
+
+def test_template_update_changes_behavior(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "c"))
+    assert len(client.review(pod()).results()) == 1
+    allow_all = """package foo
+violation[{"msg": "never"}] {
+    1 == 2
+}
+"""
+    client.add_template(make_template("DenyAll", allow_all))
+    assert client.review(pod()).results() == []
+
+
+def test_add_template_idempotent(client):
+    t = make_template("DenyAll", DENY_ALL)
+    r1 = client.add_template(t)
+    r2 = client.add_template(t)
+    assert r1.handled == r2.handled == {TARGET: True}
+
+
+def test_reset(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "c"))
+    client.add_data(pod())
+    client.reset()
+    assert client.review(pod()).results() == []
+    assert client.audit().results() == []
+    assert client.known_templates() == []
+
+
+def test_tracing(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "c"))
+    rsps = client.review(pod(), tracing=True)
+    trace = rsps.traces()
+    assert "eval" in trace
+    assert rsps.by_target[TARGET].input is not None
+    # tracing off -> no trace payload
+    assert client.review(pod()).by_target[TARGET].trace is None
+
+
+def test_create_crd(client):
+    crd = client.create_crd(make_template("DenyAll", DENY_ALL))
+    assert crd.name == "denyall.constraints.gatekeeper.sh"
+    d = crd.to_dict()
+    assert d["spec"]["names"]["kind"] == "DenyAll"
+    props = d["spec"]["validation"]["openAPIV3Schema"]["properties"]
+    assert "match" in props["spec"]["properties"]
+
+
+def test_dump(client):
+    client.add_template(make_template("DenyAll", DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "c"))
+    dump = client.dump()
+    assert "constraints" in dump
+    assert "DenyAll" in dump
+
+
+def test_template_with_lib_via_import(client):
+    """`import data.lib.helpers` (the standard upstream library pattern)
+    must be rewritten alongside refs/calls — a silent no-op here would
+    leave the policy unenforced."""
+    rego = """package foo
+import data.lib.helpers
+violation[{"msg": "BAD NAME"}] {
+    helpers.bad_names[input.review.object.metadata.name]
+}
+"""
+    lib = """package lib.helpers
+bad_names = {"badpod", "worse"}
+"""
+    client.add_template(make_template("ImportLib", rego, libs=[lib]))
+    client.add_constraint(make_constraint("ImportLib", "c"))
+    assert client.review(pod(name="badpod")).results()[0].msg == "BAD NAME"
+    assert client.review(pod(name="fine")).results() == []
+
+
+def test_import_extern_validation(client):
+    rego = """package foo
+import data.constraints
+violation[{"msg": "x"}] {
+    constraints[_]
+}
+"""
+    with pytest.raises(InvalidTemplateError):
+        client.add_template(make_template("BadImport", rego))
+
+
+def test_template_update_via_constructed_object(client):
+    """Directly-constructed ConstraintTemplate objects (no raw dict) must
+    not short-circuit the update path via degenerate equality."""
+    from gatekeeper_tpu.constraint.templates import ConstraintTemplate, TargetSpec
+
+    def ct(rego):
+        return ConstraintTemplate(
+            name="denyall",
+            kind="DenyAll",
+            targets=[TargetSpec(target=TARGET, rego=rego)],
+        )
+
+    client.add_template(ct(DENY_ALL))
+    client.add_constraint(make_constraint("DenyAll", "c"))
+    assert len(client.review(pod()).results()) == 1
+    client.add_template(ct("package foo\nviolation[{\"msg\": \"n\"}] { 1 == 2 }\n"))
+    assert client.review(pod()).results() == []
