@@ -154,8 +154,7 @@ class Client:
             prefix = f'templates["{target}"]["{entry.crd.kind}"]'
             self._driver.delete_modules(prefix)
             gk = (CONSTRAINT_GROUP, entry.crd.kind)
-            for cstr in list(self._constraints.get(gk, {}).values()):
-                self._remove_constraint_locked(cstr)
+            # the subtree delete covers every constraint of this kind
             self._constraints.pop(gk, None)
             self._driver.delete_data(
                 f"/constraints/{target}/cluster/{CONSTRAINT_GROUP}/{entry.crd.kind}"

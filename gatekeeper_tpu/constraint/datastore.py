@@ -41,11 +41,12 @@ class DataStore:
             return
         node = self._root
         for seg in segs[:-1]:
-            child = node.get(seg)
-            if child is None:
-                child = {}
-                node[seg] = child
-            elif not isinstance(child, dict):
+            if seg not in node:
+                node[seg] = {}
+            child = node[seg]
+            if not isinstance(child, dict):
+                # stored None leaves conflict too — absence is keyed on the
+                # dict, not the value
                 raise PathConflictError(
                     f"path segment {seg!r} is a leaf, cannot descend"
                 )

@@ -136,28 +136,35 @@ def get_ns_name(review: Any) -> Any:
     return _review_namespace(review)
 
 
-def get_ns(review: Any, ns_cache: Dict[str, Any]) -> Any:
-    """get_ns (:292-299): the namespace OBJECT for the review.
+def get_ns_candidates(review: Any, ns_cache: Dict[str, Any]) -> List[Any]:
+    """get_ns (:292-299): the namespace OBJECT(s) for the review.
 
-    Prefers `_unstable.namespace`; falls back to the synced cluster cache
-    (data.external.<target>.cluster.v1.Namespace). Returns _MISSING when
-    neither yields a value. Mirrors partial-set semantics: the fallback rule
-    requires `not _unstable.namespace`, which in Rego succeeds when the field
-    is absent OR false.
+    A partial set in the reference: clause 1 contributes `_unstable.namespace`
+    whenever the field is defined (any value, null included); clause 2
+    contributes the synced-cache object (data.external.<t>.cluster.v1.
+    Namespace[review.namespace]) whenever `not _unstable.namespace` succeeds —
+    i.e. the field is absent OR false. So a literal false value yields BOTH
+    members, and matches_nsselector succeeds if ANY member matches.
     """
+    out: List[Any] = []
     unstable_ns = _MISSING
     if isinstance(review, dict):
         unstable = review.get("_unstable")
         if isinstance(unstable, dict) and "namespace" in unstable:
             unstable_ns = unstable["namespace"]
     if unstable_ns is not _MISSING:
-        if unstable_ns is not False:
-            return unstable_ns
-        # false is falsy in Rego: both get_ns clauses may contribute; prefer
-        # the cache value if present, else the literal false.
+        out.append(unstable_ns)
+    if unstable_ns is _MISSING or unstable_ns is False:
         cached = _cached_ns(review, ns_cache)
-        return cached if cached is not _MISSING else False
-    return _cached_ns(review, ns_cache)
+        if cached is not _MISSING:
+            out.append(cached)
+    return out
+
+
+def get_ns(review: Any, ns_cache: Dict[str, Any]) -> Any:
+    """First get_ns candidate, or _MISSING (single-value convenience)."""
+    cands = get_ns_candidates(review, ns_cache)
+    return cands[0] if cands else _MISSING
 
 
 def _cached_ns(review: Any, ns_cache: Dict[str, Any]) -> Any:
@@ -350,13 +357,13 @@ def matches_nsselector(
         return any_labelselector_match(
             get_default(match, "namespaceSelector", {}), review
         )
-    ns = get_ns(review, ns_cache)
-    if ns is _MISSING:
-        return False
-    metadata = get_default(ns, "metadata", {})
-    nslabels = get_default(metadata, "labels", {})
     selector = get_default(match, "namespaceSelector", {})
-    return matches_label_selector(selector, nslabels)
+    for ns in get_ns_candidates(review, ns_cache):
+        metadata = get_default(ns, "metadata", {})
+        nslabels = get_default(metadata, "labels", {})
+        if matches_label_selector(selector, nslabels):
+            return True
+    return False
 
 
 def _has_field(obj: Any, field: str) -> bool:

@@ -1,0 +1,17 @@
+"""TPU evaluation engine: vectorized kernels over flattened policy state.
+
+The compute core of the framework. Where the reference evaluates one
+interpreted Rego query per review (drivers/local/local.go:302 wrapping the
+OPA topdown interpreter), this package compiles constraint match specs and
+template violation rules into dense JAX programs evaluated for the whole
+[n_constraints, n_resources] cross-product in a single jitted call:
+
+  * matchspec/matchkernel — constraint `spec.match` → int tensors → the
+    batched match matrix (the vectorization of
+    pkg/target/target_template_source.go's matching_constraints).
+  * compile/predkernel (template rules) — the Rego-subset compiler from
+    violation rules to token-table predicate programs.
+"""
+
+from .matchspec import MatchSpecSet, compile_match_specs  # noqa: F401
+from .matchkernel import match_matrix  # noqa: F401

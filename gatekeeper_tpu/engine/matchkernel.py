@@ -1,0 +1,220 @@
+"""The batched constraint×resource match kernel.
+
+One jitted call computes the full [C, N] boolean match matrix that the
+reference evaluates as C×N interpreted Rego queries over
+`matching_constraints` (pkg/target/target_template_source.go:27-44). All
+operands are small-int comparisons and masked reductions — pure VPU work
+that XLA fuses into a handful of elementwise kernels; there is no gather
+into host vocab and no string touch on device.
+
+Shape conventions: constraint tensors are [C, ...], review features [N, ...],
+everything broadcasts to [C, N]. Padded slots are -1 and excluded by
+validity masks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .matchspec import (
+    MatchSpecSet,
+    OP_ALWAYS_VIOLATED,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_EXISTS,
+    OP_NOT_IN,
+    SCOPE_ABSENT,
+    SCOPE_CLUSTER,
+    SCOPE_NAMESPACED,
+    SCOPE_STAR,
+    WILDCARD,
+)
+
+
+def _isin(needle, haystack):
+    """needle [..., 1] in haystack [..., M] (-1 pads never match)."""
+    return jnp.any(
+        (haystack != -1) & (haystack == needle[..., None]), axis=-1
+    )
+
+
+def _selector_match(invalid, ml, expr, expr_vals, labels):
+    """LabelSelector vs label pairs.
+
+    invalid [C], ml [C,P,2], expr [C,E,3], expr_vals [C,E,V],
+    labels [N,ML,2]  ->  [C,N] bool.
+    """
+    lab_k = labels[None, :, :, 0]  # [1, N, ML]
+    lab_v = labels[None, :, :, 1]
+
+    # matchLabels: every declared pair present & equal
+    ml_k = ml[:, None, :, 0]  # [C, 1, P]
+    ml_v = ml[:, None, :, 1]
+    pair_valid = ml_k != -1
+    # [C, N, P, ML]: label j satisfies pair p
+    hit = (lab_k[:, :, None, :] == ml_k[..., None]) & (
+        lab_v[:, :, None, :] == ml_v[..., None]
+    )
+    pair_ok = jnp.any(hit, axis=-1)  # [C, N, P]
+    ml_ok = jnp.all(~pair_valid | pair_ok, axis=-1)  # [C, N]
+
+    # matchExpressions
+    e_key = expr[:, None, :, 0]  # [C, 1, E]
+    e_op = expr[:, None, :, 1]
+    e_nv = expr[:, None, :, 2]
+    key_hit = lab_k[:, :, None, :] == e_key[..., None]  # [C, N, E, ML]
+    has_key = jnp.any(key_hit, axis=-1)  # [C, N, E]
+    # value of the matching label (keys unique per object)
+    label_val = jnp.max(
+        jnp.where(key_hit, lab_v[:, :, None, :], -1), axis=-1
+    )  # [C, N, E]
+    in_vals = _isin(label_val, expr_vals[:, None, :, :])  # [C, N, E]
+
+    violated = jnp.zeros_like(has_key, dtype=bool)
+    violated = jnp.where(
+        e_op == OP_IN, ~has_key | ((e_nv > 0) & ~in_vals), violated
+    )
+    violated = jnp.where(
+        e_op == OP_NOT_IN, has_key & (e_nv > 0) & in_vals, violated
+    )
+    violated = jnp.where(e_op == OP_EXISTS, ~has_key, violated)
+    violated = jnp.where(e_op == OP_NOT_EXISTS, has_key, violated)
+    violated = jnp.where(e_op == OP_ALWAYS_VIOLATED, True, violated)
+    any_violated = jnp.any(violated, axis=-1)  # [C, N]
+
+    return ml_ok & ~any_violated & ~invalid[:, None]
+
+
+def _labelselector_4case(invalid, ml, expr, expr_vals, fb):
+    """any_labelselector_match (target_template_source.go:233-281): OR over
+    object/oldObject labels according to which of the two is present."""
+    m_obj = _selector_match(invalid, ml, expr, expr_vals, fb["obj_labels"])
+    m_old = _selector_match(invalid, ml, expr, expr_vals, fb["old_labels"])
+    obj_p = fb["obj_present"][None, :]
+    old_p = fb["old_present"][None, :]
+    both = m_obj | m_old
+    # obj&old -> OR; only old -> old; only obj or neither -> obj (neither:
+    # obj_labels is all-pad == empty labels, exactly the 4th clause)
+    return jnp.where(
+        obj_p & old_p, both, jnp.where(old_p & ~obj_p, m_old, m_obj)
+    )
+
+
+@partial(jax.jit, static_argnames=())
+def match_matrix(ms: dict, fb: dict) -> jnp.ndarray:
+    """[C, N] bool — matching_constraints for every (constraint, review).
+
+    `ms`/`fb` are dicts of jnp arrays (MatchSpecSet / FeatureBatch fields);
+    passing dicts keeps the jit cache keyed purely on shapes.
+    """
+    # kind selector (:131-156)
+    rows = ms["kind_rows"]  # [C, K, 2]
+    g = rows[:, None, :, 0]  # [C, 1, K]
+    k = rows[:, None, :, 1]
+    rg = fb["group_id"][None, :, None]  # [1, N, 1]
+    rk = fb["kind_id"][None, :, None]
+    row_valid = (g != -1) & (g > -3) | (g == WILDCARD)
+    g_ok = (g == WILDCARD) | ((rg >= 0) & (g == rg))
+    k_ok = (k == WILDCARD) | ((rk >= 0) & (k == rk))
+    kind_ok = jnp.any(row_valid & g_ok & k_ok, axis=-1)  # [C, N]
+
+    # always_match_ns_selectors (:311-314): `not is_ns(input.review.kind)`
+    # has its operand hoisted, so an undefined kind fails the clause
+    always = (
+        fb["kind_defined"] & ~fb["is_ns"] & ~fb["has_namespace"]
+    )[None, :]  # [1, N]
+    ns_name = fb["ns_name_id"]  # [N]
+    ns_defined = (ns_name >= 0)[None, :]
+
+    # namespaces (:316-332)
+    in_ns = _isin(ns_name[None, :], ms["ns_ids"][:, None, :])
+    ns_ok = ~ms["ns_has"][:, None] | always | (ns_defined & in_ns)
+
+    # excludedNamespaces (:334-350)
+    in_excl = _isin(ns_name[None, :], ms["excl_ids"][:, None, :])
+    excl_ok = ~ms["excl_has"][:, None] | always | (ns_defined & ~in_excl)
+
+    # scope (:162-178)
+    sc = ms["scope"][:, None]
+    has_ns = fb["has_namespace"][None, :]
+    scope_ok = (
+        (sc == SCOPE_ABSENT)
+        | (sc == SCOPE_STAR)
+        | ((sc == SCOPE_NAMESPACED) & has_ns)
+        | ((sc == SCOPE_CLUSTER) & ~has_ns)
+    )
+
+    # namespaceSelector (:352-386)
+    nssel_plain = _selector_match(
+        ms["nssel_invalid"],
+        ms["nssel_ml"],
+        ms["nssel_expr"],
+        ms["nssel_expr_vals"],
+        fb["nssel_labels"],
+    )
+    nssel_self = _labelselector_4case(
+        ms["nssel_invalid"],
+        ms["nssel_ml"],
+        ms["nssel_expr"],
+        ms["nssel_expr_vals"],
+        fb,
+    )
+    is_ns = fb["is_ns"][None, :]
+    # second get_ns candidate with empty labels (partial-set semantics):
+    # selector-vs-empty is constraint-static, computed host-side
+    nssel_with_empty = nssel_plain | (
+        fb["nssel_empty"][None, :] & ms["nssel_matches_empty"][:, None]
+    )
+    nssel_eval = jnp.where(
+        is_ns, nssel_self, fb["nssel_defined"][None, :] & nssel_with_empty
+    )
+    nssel_ok = ~ms["nssel_has"][:, None] | always | nssel_eval
+
+    # labelSelector (:233-281)
+    label_ok = _labelselector_4case(
+        ms["lab_invalid"], ms["lab_ml"], ms["lab_expr"], ms["lab_expr_vals"], fb
+    )
+
+    return kind_ok & ns_ok & excl_ok & scope_ok & nssel_ok & label_ok
+
+
+def matchspec_to_device(ms: MatchSpecSet) -> dict:
+    return {
+        "kind_rows": jnp.asarray(ms.kind_rows),
+        "ns_has": jnp.asarray(ms.ns_has),
+        "ns_ids": jnp.asarray(ms.ns_ids),
+        "excl_has": jnp.asarray(ms.excl_has),
+        "excl_ids": jnp.asarray(ms.excl_ids),
+        "scope": jnp.asarray(ms.scope),
+        "lab_invalid": jnp.asarray(ms.lab_invalid),
+        "lab_ml": jnp.asarray(ms.lab_ml),
+        "lab_expr": jnp.asarray(ms.lab_expr),
+        "lab_expr_vals": jnp.asarray(ms.lab_expr_vals),
+        "nssel_has": jnp.asarray(ms.nssel_has),
+        "nssel_matches_empty": jnp.asarray(ms.nssel_matches_empty),
+        "nssel_invalid": jnp.asarray(ms.nssel_invalid),
+        "nssel_ml": jnp.asarray(ms.nssel_ml),
+        "nssel_expr": jnp.asarray(ms.nssel_expr),
+        "nssel_expr_vals": jnp.asarray(ms.nssel_expr_vals),
+    }
+
+
+def features_to_device(fb) -> dict:
+    return {
+        "group_id": jnp.asarray(fb.group_id),
+        "kind_id": jnp.asarray(fb.kind_id),
+        "kind_defined": jnp.asarray(fb.kind_defined),
+        "is_ns": jnp.asarray(fb.is_ns),
+        "has_namespace": jnp.asarray(fb.has_namespace),
+        "ns_name_id": jnp.asarray(fb.ns_name_id),
+        "obj_present": jnp.asarray(fb.obj_present),
+        "old_present": jnp.asarray(fb.old_present),
+        "obj_labels": jnp.asarray(fb.obj_labels),
+        "old_labels": jnp.asarray(fb.old_labels),
+        "nssel_defined": jnp.asarray(fb.nssel_defined),
+        "nssel_labels": jnp.asarray(fb.nssel_labels),
+        "nssel_empty": jnp.asarray(fb.nssel_empty),
+    }

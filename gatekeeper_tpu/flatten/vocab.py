@@ -1,0 +1,127 @@
+"""Append-only string vocabulary with intern-time precomputation.
+
+Ids are stable (append-only), so growing the vocab never invalidates
+previously encoded tensors. Value-typed interning (`val_id`) tags
+non-string JSON scalars so cross-type equality can never alias.
+
+Expensive string predicates (regex match, prefix match, k8s quantity
+parsing) are evaluated once per distinct vocab entry and memoized —
+the TPU analog of doing `re_match`/`startswith`/quantity parsing inside
+OPA's interpreter loop per object (e.g. the reference library's
+k8srequiredlabels regex check and k8scontainerlimits quantity math).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+# k8s resource.Quantity suffixes (apimachinery resource.ParseQuantity)
+_QUANTITY_RE = re.compile(
+    r"^([+-]?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
+    r"(m|k|M|G|T|P|E|Ki|Mi|Gi|Ti|Pi|Ei)?$"
+)
+_SUFFIX = {
+    None: 1.0,
+    "m": 1e-3,
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "P": 1e15,
+    "E": 1e18,
+    "Ki": 2.0**10,
+    "Mi": 2.0**20,
+    "Gi": 2.0**30,
+    "Ti": 2.0**40,
+    "Pi": 2.0**50,
+    "Ei": 2.0**60,
+}
+
+
+def parse_quantity(s: str) -> Optional[float]:
+    """Parse a k8s resource quantity ("100m", "1Gi", "2") to a float."""
+    if not isinstance(s, str):
+        return None
+    m = _QUANTITY_RE.match(s.strip())
+    if not m:
+        return None
+    return float(m.group(1)) * _SUFFIX[m.group(2)]
+
+
+class Vocab:
+    """Interned strings + per-entry predicate caches."""
+
+    def __init__(self):
+        self._ids: Dict[str, int] = {}
+        self._strs: List[str] = []
+        # entry-id -> parsed quantity (or None)
+        self._quantity: List[Optional[float]] = []
+        # regex pattern -> {entry_id: bool} lazy caches
+        self._regex_cache: Dict[str, Dict[int, bool]] = {}
+        self._prefix_cache: Dict[str, Dict[int, bool]] = {}
+
+    def __len__(self) -> int:
+        return len(self._strs)
+
+    def intern(self, s: str) -> int:
+        i = self._ids.get(s)
+        if i is None:
+            i = len(self._strs)
+            self._ids[s] = i
+            self._strs.append(s)
+            self._quantity.append(parse_quantity(s))
+        return i
+
+    def lookup(self, s: str) -> int:
+        """Id of s, or -1 if never interned (safe for probe-only queries)."""
+        return self._ids.get(s, -1)
+
+    def string(self, i: int) -> str:
+        return self._strs[i]
+
+    def quantity(self, i: int) -> Optional[float]:
+        return self._quantity[i]
+
+    # -- typed value interning ---------------------------------------------
+
+    def val_id(self, v: Any) -> int:
+        """Intern an arbitrary JSON scalar with a type tag, so "1" != 1 and
+        "true" != true under id equality."""
+        if isinstance(v, str):
+            return self.intern("s:" + v)
+        return self.intern("j:" + json.dumps(v, sort_keys=True))
+
+    def str_id(self, v: str) -> int:
+        return self.intern("s:" + v)
+
+    def str_lookup(self, v: str) -> int:
+        return self.lookup("s:" + v)
+
+    # -- precomputed predicates --------------------------------------------
+
+    def regex_matches(self, pattern: str, entry_id: int) -> bool:
+        cache = self._regex_cache.setdefault(pattern, {})
+        hit = cache.get(entry_id)
+        if hit is None:
+            s = self._strs[entry_id]
+            if s.startswith("s:"):
+                s = s[2:]
+            try:
+                hit = re.search(pattern, s) is not None
+            except re.error:
+                hit = False
+            cache[entry_id] = hit
+        return hit
+
+    def prefix_matches(self, prefix: str, entry_id: int) -> bool:
+        cache = self._prefix_cache.setdefault(prefix, {})
+        hit = cache.get(entry_id)
+        if hit is None:
+            s = self._strs[entry_id]
+            if s.startswith("s:"):
+                s = s[2:]
+            hit = s.startswith(prefix)
+            cache[entry_id] = hit
+        return hit

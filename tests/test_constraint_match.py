@@ -49,13 +49,16 @@ def constraint(name, kind="TestKind", match=None, spec_extra=None):
     }
 
 
+_NO_UNSTABLE = object()
+
+
 def pod_review(
     namespace="prod",
     labels=None,
     old_labels=None,
     kind=("", "v1", "Pod"),
     name="mypod",
-    unstable_ns=None,
+    unstable_ns=_NO_UNSTABLE,
     omit_namespace=False,
     omit_object=False,
 ):
@@ -77,7 +80,7 @@ def pod_review(
         review["oldObject"] = {
             "metadata": {"name": name, "labels": old_labels}
         }
-    if unstable_ns is not None:
+    if unstable_ns is not _NO_UNSTABLE:
         review["_unstable"] = {"namespace": unstable_ns}
     return review
 
@@ -196,6 +199,14 @@ CONSTRAINTS = [
     ),
     constraint("nssel-empty", match={"namespaceSelector": {}}),
     constraint(
+        "nssel-absent-x",
+        match={
+            "namespaceSelector": {
+                "matchExpressions": [{"key": "x", "operator": "DoesNotExist"}]
+            }
+        },
+    ),
+    constraint(
         "combo",
         match={
             "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
@@ -220,6 +231,15 @@ REVIEWS = {
         namespace="nowhere",
         labels={"app": "nginx"},
         unstable_ns={"metadata": {"name": "nowhere", "labels": {"env": "prod"}}},
+    ),
+    # `_unstable.namespace: false` is the one value where get_ns is a true
+    # partial set: both the literal false (empty labels) and the cached
+    # namespace object are candidates
+    "pod-unstable-false": pod_review(
+        namespace="prod", labels={"app": "nginx"}, unstable_ns=False
+    ),
+    "pod-unstable-null": pod_review(
+        namespace="prod", labels={"app": "nginx"}, unstable_ns=None
     ),
     "pod-update-labels": pod_review(
         labels={"app": "nginx"}, old_labels={"app": "redis"}

@@ -1,0 +1,168 @@
+"""Differential tests: JAX match kernel vs the native match oracle.
+
+The kernel (gatekeeper_tpu/engine) must agree bit-for-bit with
+constraint.match (itself differentially pinned against the reference's Rego
+matching library in test_constraint_match.py), across the structured case
+battery and a seeded random fuzz of constraint×review combinations.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.constraint import match as M
+from gatekeeper_tpu.engine.matchkernel import (
+    features_to_device,
+    match_matrix,
+    matchspec_to_device,
+)
+from gatekeeper_tpu.engine.matchspec import compile_match_specs
+from gatekeeper_tpu.flatten import (
+    Vocab,
+    batch_review_features,
+    encode_review_features,
+)
+
+from test_constraint_match import CONSTRAINTS, NS_CACHE, REVIEWS, constraint
+
+
+def kernel_matrix(constraints, reviews, ns_cache):
+    vocab = Vocab()
+    ms = compile_match_specs(constraints, vocab)
+    feats = [encode_review_features(r, ns_cache, vocab) for r in reviews]
+    fb = batch_review_features(feats)
+    out = match_matrix(matchspec_to_device(ms), features_to_device(fb))
+    return np.asarray(out)
+
+
+def oracle_matrix(constraints, reviews, ns_cache):
+    out = np.zeros((len(constraints), len(reviews)), bool)
+    for i, c in enumerate(constraints):
+        for j, r in enumerate(reviews):
+            out[i, j] = M.matches_constraint(c, r, ns_cache)
+    return out
+
+
+def _assert_agree(constraints, reviews, ns_cache):
+    got = kernel_matrix(constraints, reviews, ns_cache)
+    want = oracle_matrix(constraints, reviews, ns_cache)
+    if not np.array_equal(got, want):
+        bad = np.argwhere(got != want)
+        i, j = bad[0]
+        raise AssertionError(
+            f"{len(bad)} disagreements; first: constraint "
+            f"{constraints[i]['metadata']['name']} x review #{j} "
+            f"kernel={got[i, j]} oracle={want[i, j]}\n"
+            f"constraint={constraints[i]!r}\nreview={reviews[j]!r}"
+        )
+
+
+def test_battery_agrees():
+    _assert_agree(CONSTRAINTS, list(REVIEWS.values()), NS_CACHE)
+
+
+def _random_constraint(rng, idx):
+    match = {}
+    if rng.random() < 0.6:
+        sels = []
+        for _ in range(rng.randint(1, 2)):
+            sels.append(
+                {
+                    "apiGroups": rng.sample(["", "apps", "*", "rbac"], rng.randint(1, 2)),
+                    "kinds": rng.sample(
+                        ["Pod", "Deployment", "Namespace", "*", "Service"],
+                        rng.randint(1, 2),
+                    ),
+                }
+            )
+        match["kinds"] = sels
+    if rng.random() < 0.4:
+        match["namespaces"] = rng.sample(
+            ["prod", "dev", "other", "nowhere"], rng.randint(1, 3)
+        )
+    if rng.random() < 0.4:
+        match["excludedNamespaces"] = rng.sample(
+            ["prod", "dev", "other"], rng.randint(1, 2)
+        )
+    if rng.random() < 0.5:
+        match["scope"] = rng.choice(["*", "Cluster", "Namespaced"])
+    if rng.random() < 0.5:
+        sel = {}
+        if rng.random() < 0.7:
+            sel["matchLabels"] = {
+                rng.choice(["app", "env", "tier"]): rng.choice(
+                    ["nginx", "redis", "prod", "web"]
+                )
+            }
+        if rng.random() < 0.5:
+            op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist", "Weird"])
+            expr = {"key": rng.choice(["app", "env", "missing"]), "operator": op}
+            if rng.random() < 0.8:
+                expr["values"] = rng.sample(
+                    ["nginx", "redis", "prod"], rng.randint(0, 2)
+                )
+            sel["matchExpressions"] = [expr]
+        match["labelSelector"] = sel
+    if rng.random() < 0.4:
+        match["namespaceSelector"] = {
+            "matchLabels": {"env": rng.choice(["prod", "dev", "qa"])}
+        }
+    return constraint(f"rand-{idx}", match=match)
+
+
+def _random_review(rng):
+    kind = rng.choice(
+        [
+            ("", "v1", "Pod"),
+            ("", "v1", "Namespace"),
+            ("apps", "v1", "Deployment"),
+            ("rbac", "v1", "ClusterRole"),
+        ]
+    )
+    group, version, k = kind
+    review = {"kind": {"group": group, "version": version, "kind": k}, "name": "x"}
+    ns = rng.choice(["prod", "dev", "nowhere", None])
+    if k == "Namespace":
+        obj = {"metadata": {"name": rng.choice(["prod", "dev", "fresh"])}}
+        if rng.random() < 0.6:
+            obj["metadata"]["labels"] = {"env": rng.choice(["prod", "dev"])}
+        if rng.random() < 0.8:
+            review["object"] = obj
+        if rng.random() < 0.3:
+            review["oldObject"] = {
+                "metadata": {"name": "old", "labels": {"env": "dev"}}
+            }
+    else:
+        if ns is not None and k != "ClusterRole":
+            review["namespace"] = ns
+        obj = {"metadata": {"name": "x"}}
+        if rng.random() < 0.7:
+            obj["metadata"]["labels"] = {
+                rng.choice(["app", "env"]): rng.choice(["nginx", "redis", "prod"])
+            }
+        if rng.random() < 0.9:
+            review["object"] = obj
+        if rng.random() < 0.3:
+            review["oldObject"] = {
+                "metadata": {"name": "x", "labels": {"app": "redis"}}
+            }
+        if rng.random() < 0.2:
+            review["_unstable"] = {
+                "namespace": {
+                    "metadata": {"name": ns or "u", "labels": {"env": "prod"}}
+                }
+            }
+    return review
+
+
+def test_fuzz_agrees():
+    rng = random.Random(20260729)
+    constraints = [_random_constraint(rng, i) for i in range(120)]
+    reviews = [_random_review(rng) for _ in range(80)]
+    _assert_agree(constraints, reviews, NS_CACHE)
+
+
+def test_empty_constraint_set():
+    got = kernel_matrix([], list(REVIEWS.values()), NS_CACHE)
+    assert got.shape[0] == 0
