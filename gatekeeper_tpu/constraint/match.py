@@ -161,12 +161,6 @@ def get_ns_candidates(review: Any, ns_cache: Dict[str, Any]) -> List[Any]:
     return out
 
 
-def get_ns(review: Any, ns_cache: Dict[str, Any]) -> Any:
-    """First get_ns candidate, or _MISSING (single-value convenience)."""
-    cands = get_ns_candidates(review, ns_cache)
-    return cands[0] if cands else _MISSING
-
-
 def _cached_ns(review: Any, ns_cache: Dict[str, Any]) -> Any:
     name = _review_namespace(review)
     if name is _MISSING or not isinstance(ns_cache, dict):
@@ -179,22 +173,51 @@ def _cached_ns(review: Any, ns_cache: Dict[str, Any]) -> Any:
 # -- label selector logic ---------------------------------------------------
 
 
+def rego_scalar_eq(a: Any, b: Any) -> bool:
+    """Rego equality for scalars: true != 1 (unlike Python), 1.0 == 1."""
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    return a == b
+
+
+def values_shape(values: Any):
+    """Normalize matchExpressions `values` the way the Rego evaluates it:
+    returns (count_positive, elems) where count_positive reflects
+    `count(values) > 0` (None when count() errors — numbers/bools/null)
+    and elems are the members `values[_]` can yield (strings iterate to
+    nothing, dicts to their values)."""
+    if isinstance(values, list):
+        return len(values) > 0, values
+    if isinstance(values, dict):
+        return len(values) > 0, list(values.values())
+    if isinstance(values, str):
+        return len(values) > 0, []
+    return None, []  # count(number/bool/null) is a builtin error
+
+
 def match_expression_violated(
     operator: Any, labels: Dict[str, Any], key: Any, values: Any
 ) -> bool:
     """match_expression_violated (:184-210).
 
     has_field counts any present key — null included, since null is truthy
-    in Rego (`object[field]` binds and succeeds).
+    in Rego (`object[field]` binds and succeeds). The `count(values) > 0`
+    guards only gate In/NotIn; Exists/DoesNotExist ignore values entirely.
     """
     has_key = isinstance(labels, dict) and key in labels
-    vals = values if isinstance(values, list) else []
+    count_pos, elems = values_shape(values)
     if operator == "In":
         if not has_key:
             return True
-        return len(vals) > 0 and labels[key] not in vals
+        return bool(count_pos) and not any(
+            rego_scalar_eq(labels[key], v) for v in elems
+        )
     if operator == "NotIn":
-        return has_key and len(vals) > 0 and labels[key] in vals
+        return (
+            has_key
+            and bool(count_pos)
+            and any(rego_scalar_eq(labels[key], v) for v in elems)
+        )
     if operator == "Exists":
         return not has_key
     if operator == "DoesNotExist":
@@ -209,7 +232,7 @@ def matches_label_selector(selector: Any, labels: Any) -> bool:
     match_labels = get_default(selector, "matchLabels", {})
     if isinstance(match_labels, dict):
         for k, v in match_labels.items():
-            if k not in labels or labels[k] != v:
+            if k not in labels or not rego_scalar_eq(labels[k], v):
                 return False
     elif match_labels not in ([], ""):
         # non-object matchLabels: the satisfied-count comprehension yields

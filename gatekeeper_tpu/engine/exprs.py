@@ -1,0 +1,446 @@
+"""Vectorized expression DAG for compiled template programs.
+
+Compiled violation rules are DAGs of these nodes, evaluated by tracing
+into jax.numpy under jit. Spaces (array shapes) are:
+
+    ()        -> [N]          per-resource scalars
+    ("tok",)  -> [N, L]       per-token (object-key iteration bindings)
+    ("g0",)   -> [N, G0]      per-first-level-array-element (containers)
+    ("g0","g1") -> [N, G0, G1]
+
+Nodes are pure and hash-consed per evaluation via an id-keyed memo, so
+shared subexpressions trace once. The same DAG also evaluates under numpy
+(eager) for the host-side reference path used in tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class Expr:
+    space: Tuple[str, ...] = ()
+
+    def emit(self, ctx: "EvalCtx"):
+        memo = ctx.memo
+        key = id(self)
+        if key not in memo:
+            memo[key] = self._emit(ctx)
+        return memo[key]
+
+    def _emit(self, ctx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+@dataclass
+class EvalCtx:
+    """Evaluation context: token columns, tables, per-constraint consts."""
+
+    np: Any  # numpy-like module (jax.numpy under jit)
+    tok: Dict[str, Any]  # spath/idx0/idx1/kind/vid/vnum, each [N, L]
+    pat_member: Any  # [P, Vp] bool
+    pat_capture: Any  # [P, Vp] int32
+    str_tables: Dict[str, Any]  # name -> [Vs] array
+    consts: Dict[str, Any]  # slot -> array (vmapped per constraint)
+    g0: int = 8  # first-level array fanout
+    g1: int = 8
+    memo: Dict[int, Any] = field(default_factory=dict)
+
+    @property
+    def n(self) -> int:
+        return self.tok["spath"].shape[0]
+
+    @property
+    def l(self) -> int:
+        return self.tok["spath"].shape[1]
+
+
+def _shape_for(ctx: EvalCtx, space: Tuple[str, ...]) -> Tuple[int, ...]:
+    dims = [ctx.n]
+    for ax in space:
+        dims.append(
+            {"tok": ctx.l, "g0": ctx.g0, "g1": ctx.g1, "g01": ctx.g0 * ctx.g1}[ax]
+        )
+    return tuple(dims)
+
+
+# space dominance for broadcasting; ("tok","g0") is the rank-3 join space
+_RANK = {
+    (): 0,
+    ("tok",): 1,
+    ("g0",): 1,
+    ("g01",): 2,
+    ("tok", "g0"): 3,
+    ("tok", "g01"): 3,
+}
+
+
+def _space_rank(s: Tuple[str, ...]) -> int:
+    return _RANK.get(s, 0)
+
+
+def join_spaces(a: Tuple[str, ...], b: Tuple[str, ...]) -> Tuple[str, ...]:
+    """Smallest space both broadcast into, or None."""
+    if a == b:
+        return a
+    if not a:
+        return b
+    if not b:
+        return a
+    pair = {a, b}
+    if pair == {("g0",), ("g01",)}:
+        return ("g01",)
+    if pair == {("tok",), ("g0",)} or pair == {("tok", "g0"), ("g0",)} or (
+        pair == {("tok", "g0"), ("tok",)}
+    ):
+        return ("tok", "g0")
+    if pair == {("tok",), ("g01",)} or pair == {("tok", "g01"), ("g01",)} or (
+        pair == {("tok", "g01"), ("tok",)}
+    ):
+        return ("tok", "g01")
+    if pair == {("tok", "g0"), ("g01",)} or pair == {("tok", "g01"), ("g0",)}:
+        return None
+    return None
+
+
+def _expand(ctx: EvalCtx, v, s: Tuple[str, ...], target: Tuple[str, ...]):
+    if s == target:
+        return v
+    if s == ():
+        for _ in target:
+            v = v[..., None] if hasattr(v, "ndim") else v
+        return v
+    if s == ("g0",) and target == ("g01",):
+        return ctx.np.repeat(v, ctx.g1, axis=-1)
+    if s == ("tok",) and target in (("tok", "g0"), ("tok", "g01")):
+        return v[:, :, None]
+    if s == ("g0",) and target == ("tok", "g0"):
+        return v[:, None, :]
+    if s == ("g01",) and target == ("tok", "g01"):
+        return v[:, None, :]
+    if s == ("g0",) and target == ("tok", "g01"):
+        return ctx.np.repeat(v, ctx.g1, axis=-1)[:, None, :]
+    raise ValueError(f"cannot expand {s} -> {target}")
+
+
+def broadcast(ctx: EvalCtx, vals: Sequence[Any], spaces: Sequence[Tuple[str, ...]]):
+    """Align values of compatible spaces for elementwise ops."""
+    target: Tuple[str, ...] = ()
+    for s in spaces:
+        j = join_spaces(target, s)
+        if j is None:
+            raise ValueError(f"incompatible spaces {spaces}")
+        target = j
+    out = [_expand(ctx, v, s, target) for v, s in zip(vals, spaces)]
+    return out, target
+
+
+# -- leaves -----------------------------------------------------------------
+
+
+@dataclass(eq=False)
+class ELit(Expr):
+    value: Any
+    space: Tuple[str, ...] = ()
+
+    def _emit(self, ctx):
+        return self.value
+
+
+@dataclass(eq=False)
+class EFullN(Expr):
+    """[N] array filled with a constant (anchors scalar conds to the batch)."""
+
+    value: Any
+    space: Tuple[str, ...] = ()
+
+    def _emit(self, ctx):
+        if isinstance(self.value, bool):
+            return ctx.np.full((ctx.n,), self.value)
+        return ctx.np.full((ctx.n,), self.value)
+
+
+@dataclass(eq=False)
+class EConstSlot(Expr):
+    """Per-constraint constant (scalar or padded array), fed at call time."""
+
+    slot: str
+    space: Tuple[str, ...] = ()
+
+    def _emit(self, ctx):
+        return ctx.consts[self.slot]
+
+
+@dataclass(eq=False)
+class ETokCol(Expr):
+    col: str  # spath | idx0 | idx1 | kind | vid | vnum
+    space: Tuple[str, ...] = (("tok",))
+
+    def __post_init__(self):
+        self.space = ("tok",)
+
+    def _emit(self, ctx):
+        return ctx.tok[self.col]
+
+
+@dataclass(eq=False)
+class ESelPattern(Expr):
+    """[N, L] bool: token's schema path matches the pattern."""
+
+    pattern_idx: int
+
+    def __post_init__(self):
+        self.space = ("tok",)
+
+    def _emit(self, ctx):
+        spath = ctx.tok["spath"]
+        safe = ctx.np.maximum(spath, 0)
+        return (spath >= 0) & ctx.pat_member[self.pattern_idx][safe]
+
+
+@dataclass(eq=False)
+class ECapture(Expr):
+    """[N, L] int32: captured segment id for the pattern (-1 if none)."""
+
+    pattern_idx: int
+
+    def __post_init__(self):
+        self.space = ("tok",)
+
+    def _emit(self, ctx):
+        spath = ctx.tok["spath"]
+        safe = ctx.np.maximum(spath, 0)
+        return ctx.np.where(
+            spath >= 0, ctx.pat_capture[self.pattern_idx][safe], -1
+        )
+
+
+@dataclass(eq=False)
+class EStrTable(Expr):
+    """Gather a vocab-derived table at an id expression (−1 -> default)."""
+
+    table: str
+    ids: Expr
+    default: Any = False
+
+    def __post_init__(self):
+        self.space = self.ids.space
+
+    def _emit(self, ctx):
+        ids = self.ids.emit(ctx)
+        tab = ctx.str_tables[self.table]
+        safe = ctx.np.maximum(ids, 0)
+        return ctx.np.where(ids >= 0, tab[safe], self.default)
+
+
+@dataclass(eq=False)
+class EIsInConst(Expr):
+    """ids ∈ const id set (slot holds padded [K] ids, -1 pad)."""
+
+    ids: Expr
+    slot: str
+
+    def __post_init__(self):
+        self.space = self.ids.space
+
+    def _emit(self, ctx):
+        ids = self.ids.emit(ctx)
+        members = ctx.consts[self.slot]  # [K]
+        hit = (members != -1) & (members == ids[..., None])
+        return hit.any(axis=-1)
+
+
+# -- combinators ------------------------------------------------------------
+
+
+@dataclass(eq=False)
+class EMap(Expr):
+    """Elementwise op over broadcast-aligned children."""
+
+    fn: Callable
+    args: List[Expr]
+    name: str = "map"
+
+    def __post_init__(self):
+        target: Tuple[str, ...] = ()
+        for a in self.args:
+            j = join_spaces(target, a.space)
+            if j is None:
+                raise ValueError(
+                    f"incompatible spaces {[a.space for a in self.args]}"
+                )
+            target = j
+        self.space = target
+
+    def _emit(self, ctx):
+        vals = [a.emit(ctx) for a in self.args]
+        vals, _ = broadcast(ctx, vals, [a.space for a in self.args])
+        return self.fn(ctx.np, *vals)
+
+
+def e_and(*args: Expr) -> Expr:
+    return EMap(lambda np, *vs: _fold(np, vs, lambda a, b: a & b), list(args), "and")
+
+
+def e_or(*args: Expr) -> Expr:
+    return EMap(lambda np, *vs: _fold(np, vs, lambda a, b: a | b), list(args), "or")
+
+
+def e_not(a: Expr) -> Expr:
+    return EMap(lambda np, v: ~v, [a], "not")
+
+
+def _fold(np, vs, f):
+    out = vs[0]
+    for v in vs[1:]:
+        out = f(out, v)
+    return out
+
+
+def e_cmp(op: str, a: Expr, b: Expr) -> Expr:
+    fns = {
+        "==": lambda np, x, y: x == y,
+        "!=": lambda np, x, y: x != y,
+        "<": lambda np, x, y: x < y,
+        "<=": lambda np, x, y: x <= y,
+        ">": lambda np, x, y: x > y,
+        ">=": lambda np, x, y: x >= y,
+    }
+    return EMap(fns[op], [a, b], f"cmp{op}")
+
+
+def e_arith(op: str, a: Expr, b: Expr) -> Expr:
+    fns = {
+        "+": lambda np, x, y: x + y,
+        "-": lambda np, x, y: x - y,
+        "*": lambda np, x, y: x * y,
+        "/": lambda np, x, y: x / y,
+        "%": lambda np, x, y: x % y,
+    }
+    return EMap(fns[op], [a, b], f"arith{op}")
+
+
+def e_where(c: Expr, t: Expr, f: Expr) -> Expr:
+    return EMap(lambda np, cc, tt, ff: np.where(cc, tt, ff), [c, t, f], "where")
+
+
+# -- reductions / regrouping ------------------------------------------------
+
+
+@dataclass(eq=False)
+class EReduce(Expr):
+    """Reduce the innermost axis of child's space: any | all | sum | max."""
+
+    child: Expr
+    how: str
+
+    def __post_init__(self):
+        if not self.child.space:
+            raise ValueError("cannot reduce a scalar space")
+        self.space = self.child.space[:-1]
+
+    def _emit(self, ctx):
+        v = self.child.emit(ctx)
+        np = ctx.np
+        if self.how == "any":
+            return v.any(axis=-1)
+        if self.how == "all":
+            return v.all(axis=-1)
+        if self.how == "sum":
+            return v.sum(axis=-1)
+        if self.how == "max":
+            return v.max(axis=-1)
+        raise ValueError(self.how)
+
+
+@dataclass(eq=False)
+class EReduceAxis(Expr):
+    """Reduce a NAMED axis of the child's space (any | sum)."""
+
+    child: Expr
+    axis: str
+    how: str = "any"
+
+    def __post_init__(self):
+        if self.axis not in self.child.space:
+            raise ValueError(f"axis {self.axis} not in {self.child.space}")
+        self.space = tuple(a for a in self.child.space if a != self.axis)
+
+    def _emit(self, ctx):
+        v = self.child.emit(ctx)
+        dim = 1 + self.child.space.index(self.axis)
+        if self.how == "any":
+            return v.any(axis=dim)
+        if self.how == "sum":
+            return v.sum(axis=dim)
+        raise ValueError(self.how)
+
+
+@dataclass(eq=False)
+class EGroup(Expr):
+    """Regroup per-token values onto an array-index axis.
+
+    For tokens where `mask` holds, place `value` at [n, idx] where idx is
+    the token's idx0 (axis="g0") or idx1 (axis="g1"); `init` fills empty
+    slots; `how` resolves collisions (max | any | sum).
+
+    idx1 grouping composes under an idx0 binding: pass an extra equality on
+    idx0 in the mask, and group by idx1 -> [N, G1].
+    """
+
+    mask: Expr  # [N, L] bool
+    value: Optional[Expr]  # [N, L] or None (then value := mask)
+    axis: str  # "g0" | "g1"
+    how: str = "max"
+    init: Any = -1
+
+    def __post_init__(self):
+        self.space = (self.axis,)
+
+    def _emit(self, ctx):
+        np = ctx.np
+        if self.axis == "g01":
+            g = ctx.g0 * ctx.g1
+            i0 = ctx.tok["idx0"]
+            i1 = ctx.tok["idx1"]
+            idx = np.where((i0 >= 0) & (i1 >= 0), i0 * ctx.g1 + i1, -1)
+        else:
+            g = ctx.g0 if self.axis == "g0" else ctx.g1
+            idx = ctx.tok["idx0" if self.axis == "g0" else "idx1"]
+        mask = self.mask.emit(ctx)
+        val = self.value.emit(ctx) if self.value is not None else mask
+        live = mask & (idx >= 0) & (idx < g)
+        # one-hot contraction instead of scatter: [N, L, G] fuses into a
+        # masked reduce on TPU (scatters serialize badly there); L and G
+        # are small so the broadcast intermediate is cheap
+        onehot = _onehot(ctx, idx, live, g)  # [N, L, G] bool
+        if self.how == "sum":
+            contrib = np.where(onehot, val[:, :, None], 0)
+            return contrib.sum(axis=1)
+        if self.how == "any":
+            contrib = onehot & (val[:, :, None] != 0)
+            return contrib.any(axis=1)
+        contrib = np.where(onehot, val[:, :, None], self.init)
+        return contrib.max(axis=1)
+
+
+def _onehot(ctx, idx, live, g):
+    np = ctx.np
+    slots = np.arange(g)
+    return live[:, :, None] & (idx[:, :, None] == slots[None, None, :])
+
+
+@dataclass(eq=False)
+class EGroupPresent(Expr):
+    """[N, G] bool: any selected token exists at that array index."""
+
+    mask: Expr
+    axis: str
+
+    def __post_init__(self):
+        self.space = (self.axis,)
+        self._inner = EGroup(self.mask, None, self.axis, how="any")
+
+    def _emit(self, ctx):
+        return self._inner.emit(ctx)

@@ -36,7 +36,7 @@ SCOPE_ABSENT, SCOPE_STAR, SCOPE_NAMESPACED, SCOPE_CLUSTER, SCOPE_INVALID = (
     4,
 )
 
-# matchExpression op codes
+# matchExpression op codes (OP_ALWAYS_VIOLATED retained for kernel compat)
 OP_IGNORE, OP_IN, OP_NOT_IN, OP_EXISTS, OP_NOT_EXISTS, OP_ALWAYS_VIOLATED = (
     0,
     1,
@@ -45,6 +45,10 @@ OP_IGNORE, OP_IN, OP_NOT_IN, OP_EXISTS, OP_NOT_EXISTS, OP_ALWAYS_VIOLATED = (
     4,
     5,
 )
+
+
+def _is_scalar(v):
+    return v is None or isinstance(v, (str, int, float, bool))
 _OP_CODES = {
     "In": OP_IN,
     "NotIn": OP_NOT_IN,
@@ -84,20 +88,20 @@ def _compile_selector(sel: Any, vocab: Vocab) -> _Selector:
             if not isinstance(e, dict) or "operator" not in e or "key" not in e:
                 continue
             op = e["operator"]
-            values = M.get_default(e, "values", [])
-            key_id = vocab.str_id(str(e["key"]))
-            if not isinstance(values, list):
-                # `count(values)` over a non-array: In is always violated
-                # (missing-key clause or the >0 count of a string), NotIn
-                # never is — see match.py match_expression_violated notes
-                if op == "In":
-                    exprs.append((key_id, OP_ALWAYS_VIOLATED, 0, []))
-                continue
             code = _OP_CODES.get(op, OP_IGNORE)
             if code == OP_IGNORE:
                 continue
-            ids = [vocab.val_id(v) for v in values]
-            exprs.append((key_id, code, len(ids), ids))
+            values = M.get_default(e, "values", [])
+            key_id = vocab.str_id(str(e["key"]))
+            # mirror the oracle's values normalization exactly
+            # (match.py values_shape): n_values encodes `count(values)>0`,
+            # ids are the reachable members
+            count_pos, elems = M.values_shape(values)
+            ids = [
+                vocab.val_id(v) for v in elems if _is_scalar(v)
+            ]
+            nv = 1 if count_pos else 0
+            exprs.append((key_id, code, nv, ids))
     return _Selector(invalid=invalid, ml_pairs=pairs, exprs=exprs)
 
 

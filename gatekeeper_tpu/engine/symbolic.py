@@ -1,0 +1,2107 @@
+"""Rego-subset → vectorized program compiler (symbolic partial evaluation).
+
+Compiles ConstraintTemplate `violation` rules into Expr DAGs over the
+token table, per (template, constraint-parameters) pair. The strategy is
+partial evaluation: `input.parameters` is the constraint's concrete params
+value, `input.review` is an abstract document backed by token patterns,
+and rule bodies execute symbolically — concrete subterms fold at compile
+time, review-dependent subterms emit vectorized ops.
+
+Design decisions (see SURVEY.md §7 "hard parts"):
+  * The program is a violation DETECTOR/COUNTER: it returns violations per
+    resource. Messages are rendered host-side by re-evaluating only the
+    ≤`--constraint-violations-limit` reported pairs with the interpreter,
+    so message fidelity never constrains the kernel.
+  * Document iteration is LAZY: `containers[_]` extends the abstract path
+    with "#" and the array axis only materializes at leaf reads, with an
+    occupancy guard per axis. Iterations fork into an array branch ("#")
+    and an object branch ("*" token axis) — real data matches exactly one,
+    so the other contributes zero.
+  * Pure string work (regex, prefixes, to_number, helper fns like
+    canonify_cpu) happens per distinct vocab entry on the host
+    (tables.py), never on device.
+  * Per-constraint constants land in a ConstPool (padded to power-of-two
+    buckets), so constraints of the same template with the same control
+    flow share one compiled program, called with different const tensors.
+  * Anything outside the subset raises CompileUnsupported; the driver
+    routes that template to the interpreter (hybrid routing, SURVEY.md §7).
+
+Documented approximations (differential-tested to be unobservable on the
+reference library with well-formed K8s objects):
+  * Rego set-of-violations dedup across IDENTICAL {msg, details} objects
+    is not replicated — counts assume distinct messages (library messages
+    embed container/key names).
+  * count() of token-derived sets counts tokens, not distinct values.
+  * Device numeric comparisons are float32.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..rego import ast as A
+from ..flatten.encoder import K_NUM, K_STR
+from ..flatten.vocab import Vocab
+from .exprs import (
+    ECapture,
+    EConstSlot,
+    EFullN,
+    EGroup,
+    EGroupPresent,
+    EIsInConst,
+    ELit,
+    EMap,
+    EReduce,
+    EReduceAxis,
+    ESelPattern,
+    EStrTable,
+    ETokCol,
+    Expr,
+    e_and,
+    e_arith,
+    e_cmp,
+    e_not,
+    e_or,
+    e_where,
+)
+from ..flatten.encoder import esc_seg
+from .patterns import PatternRegistry
+from .tables import StrTables
+
+NEG_INF = -(10.0**30)
+
+
+class CompileUnsupported(Exception):
+    """Template uses constructs outside the compilable subset."""
+
+
+@dataclass
+class CompilerEnv:
+    vocab: Vocab
+    patterns: PatternRegistry
+    tables: StrTables
+    # oracle_fn(fn_name, scalar_value) -> (result, defined): interpreter-
+    # backed evaluation of a pure template helper function, used to build
+    # per-vocab-entry lookup tables for functions the symbolic compiler
+    # can't inline (string canonicalizers like canonify_cpu)
+    oracle_fn: Optional[Callable[[str, Any], Tuple[Any, bool]]] = None
+    # namespace for oracle-built tables (unique per template)
+    oracle_ns: str = ""
+
+
+class ConstPool:
+    """Per-constraint constants hoisted out of the program structure."""
+
+    def __init__(self):
+        self.values: Dict[str, np.ndarray] = {}
+        self._n = 0
+
+    def scalar(self, v: float) -> Expr:
+        name = f"s{self._n}"
+        self._n += 1
+        self.values[name] = np.asarray(v, np.float32)
+        return EConstSlot(name)
+
+    def id_scalar(self, v: int) -> Expr:
+        name = f"i{self._n}"
+        self._n += 1
+        self.values[name] = np.asarray(v, np.int32)
+        return EConstSlot(name)
+
+    def id_set(self, ids: Sequence[int]) -> str:
+        """Padded [K] id array slot (for EIsInConst)."""
+        name = f"set{self._n}"
+        self._n += 1
+        k = 1
+        while k < max(len(ids), 1):
+            k *= 2
+        arr = np.full((k,), -1, np.int32)
+        for i, v in enumerate(ids):
+            arr[i] = v
+        self.values[name] = arr
+        return name
+
+
+# ---------------------------------------------------------------------------
+# Symbolic values
+
+
+class SVal:
+    pass
+
+
+@dataclass
+class SConst(SVal):
+    value: Any
+
+
+class SInput(SVal):
+    """The bare `input` document (proc-mount passes it to a helper)."""
+
+
+@dataclass
+class SNode(SVal):
+    """Abstract review subdocument at a path prefix ("#" = array level,
+    "*" = object-key iteration level)."""
+
+    prefix: Tuple[str, ...]
+
+
+def _axes_of(prefix: Tuple[str, ...]) -> Tuple[str, ...]:
+    n = sum(1 for s in prefix if s == "#")
+    if n == 0:
+        return ()
+    if n == 1:
+        return ("g0",)
+    if n == 2:
+        # two array levels flatten onto one combined axis (idx0*G1 + idx1)
+        return ("g01",)
+    raise CompileUnsupported(">2 array levels")
+
+
+@dataclass
+class SScalar(SVal):
+    """A leaf value read from the token table."""
+
+    comp: "Compiler"
+    pattern_idx: int  # -1 for derived scalars
+    axes: Tuple[str, ...] = ()
+    tok_space: bool = False
+    sel_override: Optional[Expr] = None
+    num_override: Optional[Expr] = None
+    exists_override: Optional[Expr] = None
+    # transformed string values (lower/trim/set-element bindings): ids of
+    # known-string entries, bypassing the token columns
+    vid_override: Optional[Expr] = None
+
+    @property
+    def space(self) -> Tuple[str, ...]:
+        return ("tok",) if self.tok_space else self.axes
+
+    def sel(self) -> Expr:
+        if self.sel_override is not None:
+            return self.sel_override
+        return ESelPattern(self.pattern_idx)
+
+    def exists(self) -> Expr:
+        if self.exists_override is not None:
+            return self.exists_override
+        if self.tok_space:
+            return self.sel()
+        if not self.axes:
+            return EReduce(self.sel(), "any")
+        return self._grouped(self.sel(), None, "any")
+
+    def _grouped(self, mask, value, how, init=-1):
+        if self.axes in (("g0",), ("g01",)):
+            return EGroup(mask, value, self.axes[0], how=how, init=init)
+        raise CompileUnsupported(f"axes {self.axes}")
+
+    def col(self, name: str, init=-1) -> Expr:
+        if self.num_override is not None:
+            raise CompileUnsupported("column of derived scalar")
+        if self.tok_space:
+            return ETokCol(name)
+        if not self.axes:
+            masked = EMap(
+                lambda np_, m, v: np_.where(m, v, init),
+                [self.sel(), ETokCol(name)],
+            )
+            return EReduce(masked, "max")
+        return self._grouped(self.sel(), ETokCol(name), "max", init=init)
+
+    def vid(self) -> Expr:
+        if self.vid_override is not None:
+            return self.vid_override
+        return self.col("vid", -1)
+
+    def num(self) -> Expr:
+        if self.num_override is not None:
+            return self.num_override
+        return self.col("vnum", NEG_INF)
+
+    def kindv(self) -> Expr:
+        if self.vid_override is not None:
+            return ELit(K_STR)  # transformed values are known strings
+        return self.col("kind", -1)
+
+    def truthy(self) -> Expr:
+        if self.num_override is not None:
+            return self.exists()  # derived numbers: defined => truthy
+        if self.vid_override is not None:
+            return e_and(
+                self.exists(),
+                e_not(
+                    e_cmp("==", self.vid_override, ELit(self.comp.false_id))
+                ),
+            )
+        false_id = ELit(self.comp.false_id)
+        if self.tok_space:
+            return e_and(
+                self.sel(), e_not(e_cmp("==", ETokCol("vid"), false_id))
+            )
+        return e_and(self.exists(), e_not(e_cmp("==", self.vid(), false_id)))
+
+
+@dataclass
+class SKey(SVal):
+    """Captured object-key of a token-space iteration."""
+
+    pattern_idx: int
+
+    def ids(self) -> Expr:
+        return ECapture(self.pattern_idx)
+
+
+@dataclass
+class SBool(SVal):
+    expr: Expr
+
+
+@dataclass
+class SMsg(SVal):
+    """Opaque always-defined value (sprintf output, head objects).
+
+    `sig` is a structural signature of how the value renders (format
+    string + argument source paths). Clauses whose heads carry EQUAL
+    signatures render identical strings for the same (resource, element),
+    so their violation objects collapse in Rego's result set — the
+    compiler ORs such clauses instead of summing them.
+    """
+
+    sig: Any = None
+
+    def signature(self):
+        return self.sig if self.sig is not None else ("opaque", id(self))
+
+
+@dataclass
+class STokenSet(SVal):
+    """Set/array comprehension over a token selection.
+
+    `axes` are OUTER array axes the elements are grouped under (e.g. the
+    container axis for per-container capability sets); set operations
+    reduce the token axis down to those axes via idx-grouping.
+    """
+
+    mask: Expr  # [N, L]
+    elem_ids: Expr  # [N, L]
+    axes: Tuple[str, ...] = ()
+
+    def reduce_any(self, pred_mask: Optional[Expr]) -> Expr:
+        m = e_and(self.mask, pred_mask) if pred_mask is not None else self.mask
+        if self.axes == ():
+            return EReduce(m, "any")
+        if self.axes == ("g0",):
+            return EGroup(m, None, "g0", how="any")
+        raise CompileUnsupported("token-set axes")
+
+    def reduce_count(self) -> Expr:
+        cnt = EMap(lambda np_, m: m.astype(np.int32), [self.mask], "toint")
+        if self.axes == ():
+            return EReduce(cnt, "sum")
+        if self.axes == ("g0",):
+            return EGroup(self.mask, cnt, "g0", how="sum")
+        raise CompileUnsupported("token-set axes")
+
+
+@dataclass
+class SDerived(SVal):
+    """Per-resource derived number (e.g. a count)."""
+
+    num: Expr
+    defined: Expr
+
+
+@dataclass
+class SList(SVal):
+    """Small fixed list of symbolic values (concrete-iteration
+    comprehensions like allowedrepos' `satisfied` array).
+
+    Each item carries an optional guard: the element is only present in
+    the list when the guard holds (body conditions of the producing
+    comprehension fork)."""
+
+    items: List[Tuple[Optional[Expr], SVal]]
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class State:
+    env: Dict[str, SVal]
+    cond: List[Expr] = field(default_factory=list)
+    space: Tuple[str, ...] = ()
+    # axis -> occupancy guard (array slot actually exists)
+    guards: Dict[str, Expr] = field(default_factory=dict)
+    # axis -> owning array prefix: two DIFFERENT arrays may not share a
+    # group axis in one clause (their indices would silently mis-join)
+    axis_owner: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+
+
+def _space_join(a: Tuple[str, ...], b: Tuple[str, ...]) -> Tuple[str, ...]:
+    from .exprs import join_spaces
+
+    j = join_spaces(a, b)
+    if j is None:
+        raise CompileUnsupported(f"space join {a} {b}")
+    return j
+
+
+class Compiler:
+    """Compiles one template's violation rules for one concrete params."""
+
+    def __init__(
+        self,
+        env: CompilerEnv,
+        modules: Sequence[A.Module],
+        params: Any,
+    ):
+        self.cenv = env
+        self.vocab = env.vocab
+        self.patterns = env.patterns
+        self.tables = env.tables
+        self.params = params
+        self.pool = ConstPool()
+        self.false_id = env.vocab.val_id(False)
+        self.rules: Dict[str, List[A.Rule]] = {}
+        for mod in modules:
+            for rule in mod.rules:
+                self.rules.setdefault(rule.head.name, []).append(rule)
+        self._fn_depth = 0
+        self.signature: List[Any] = []  # structural program signature
+
+    def _pattern(self, segs: Tuple[str, ...]) -> int:
+        idx = self.patterns.register(segs)
+        self.signature.append(("pat", segs))
+        return idx
+
+    # -- entry --------------------------------------------------------------
+
+    def compile_violation_counts(self) -> Expr:
+        clauses = self.rules.get("violation")
+        if not clauses:
+            raise CompileUnsupported("no violation rule")
+        branches: List[Tuple[Any, Tuple[str, ...], Expr]] = []
+        for rule in clauses:
+            if rule.is_default or rule.else_rule is not None:
+                raise CompileUnsupported("default/else violation rule")
+            branches.extend(self._compile_clause(rule))
+        if not branches:
+            return EFullN(0)
+        # Rego's violation document is a SET: clauses rendering the same
+        # {msg, details} object for the same (resource, element) collapse
+        # (e.g. containerlimits' two "has no resource limits" clauses).
+        # Branches with EQUAL head signatures on the same space are OR'd;
+        # everything else sums.
+        grouped: Dict[Any, Expr] = {}
+        order: List[Any] = []
+        for sig, space, cond in branches:
+            key = (sig, space)
+            if key in grouped:
+                grouped[key] = e_or(grouped[key], cond)
+            else:
+                grouped[key] = cond
+                order.append(key)
+        counts: List[Expr] = []
+        for key in order:
+            cond = grouped[key]
+            cnt = EMap(lambda np_, c: c.astype(np.int32), [cond], "toint")
+            while cnt.space:
+                cnt = EReduceAxis(cnt, cnt.space[-1], "sum")
+            counts.append(cnt)
+        total = counts[0]
+        for c in counts[1:]:
+            total = e_arith("+", total, c)
+        return total
+
+    def _compile_clause(
+        self, rule: A.Rule
+    ) -> List[Tuple[Any, Tuple[str, ...], Expr]]:
+        finals = self._eval_body(rule.body, State(env={}))
+        outs: List[Tuple[Any, Tuple[str, ...], Expr]] = []
+        for st in finals:
+            # the head must evaluate too (undefined heads drop violations);
+            # its render-signature drives cross-clause set dedup
+            head_forks = self._eval_term(rule.head.key, st)
+            for hv, hs in head_forks:
+                cond = self._conj(hs)
+                outs.append((_freeze_sig(_val_sig(hv)), cond.space, cond))
+        return outs
+
+    def _conj(self, st: State) -> Expr:
+        # anchor to [N] so fully-concrete bodies still count per resource
+        out: Expr = EFullN(True)
+        for c in list(st.cond) + [g for g in st.guards.values()]:
+            out = e_and(out, c)
+        return out
+
+    # -- body ---------------------------------------------------------------
+
+    def _eval_body(self, body: List[A.Expr], state: State) -> List[State]:
+        states = [state]
+        for expr in body:
+            nxt: List[State] = []
+            for st in states:
+                nxt.extend(self._eval_expr(expr, st))
+            if not nxt:
+                return []
+            states = nxt
+            if len(states) > 64:
+                raise CompileUnsupported("fork explosion")
+        return states
+
+    def _eval_expr(self, expr: A.Expr, st: State) -> List[State]:
+        if isinstance(expr, A.SomeDecl):
+            return [st]
+        if isinstance(expr, A.Assign):
+            return self._eval_assign(expr.target, expr.value, st)
+        if isinstance(expr, A.Unify):
+            return self._eval_unify(expr.lhs, expr.rhs, st)
+        if isinstance(expr, A.TermExpr):
+            return self._eval_cond_term(expr.term, st)
+        if isinstance(expr, A.NotExpr):
+            return self._eval_not(expr.expr, st)
+        if isinstance(expr, A.WithExpr):
+            raise CompileUnsupported("with modifier")
+        raise CompileUnsupported(f"expr {type(expr).__name__}")
+
+    def _node_exists_cond(self, node: SNode) -> Optional[Expr]:
+        """Definedness of an abstract node (any token beneath it)."""
+        if "*" in node.prefix:
+            raise CompileUnsupported("existence under object iteration")
+        pat = self._pattern(node.prefix + ("**",))
+        axes = _axes_of(node.prefix)
+        sel = ESelPattern(pat)
+        if not axes:
+            return EReduce(sel, "any")
+        if axes in (("g0",), ("g01",)):
+            return EGroup(sel, None, axes[0], how="any")
+        raise CompileUnsupported("existence axes")
+
+    def _eval_assign(self, target, value, st: State) -> List[State]:
+        if isinstance(target, A.Wildcard):
+            return self._eval_cond_term(value, st)
+        if not isinstance(target, A.Var):
+            raise CompileUnsupported("destructuring assignment")
+        out = []
+        for val, st2 in self._eval_term(value, st):
+            if isinstance(val, SNode) and not val.prefix[-1:] == ("#",):
+                # `x := path` fails when the path is undefined — the
+                # binding itself requires existence (observable through
+                # later negations, e.g. containerlimits' parse clauses).
+                # Iteration elements (prefix ending in "#") are already
+                # guaranteed by the axis occupancy guard.
+                st2 = replace(
+                    st2, cond=st2.cond + [self._node_exists_cond(val)]
+                )
+            env = dict(st2.env)
+            env[target.name] = val
+            out.append(replace(st2, env=env))
+        return out
+
+    def _eval_unify(self, lhs, rhs, st: State) -> List[State]:
+        lvar = isinstance(lhs, A.Var) and lhs.name not in st.env
+        rvar = isinstance(rhs, A.Var) and rhs.name not in st.env
+        if lvar and not rvar:
+            return self._eval_assign(lhs, rhs, st)
+        if rvar and not lvar:
+            return self._eval_assign(rhs, lhs, st)
+        if isinstance(lhs, A.Wildcard):
+            return self._eval_cond_term(rhs, st)
+        if isinstance(rhs, A.Wildcard):
+            return self._eval_cond_term(lhs, st)
+        return self._eval_cond_term(A.BinOp(op="==", lhs=lhs, rhs=rhs), st)
+
+    def _eval_not(self, inner: A.Expr, st: State) -> List[State]:
+        sub = State(env=dict(st.env), space=st.space, guards=dict(st.guards), axis_owner=dict(st.axis_owner))
+        finals = self._eval_body([inner], sub)
+        if not finals:
+            return [st]  # statically undefined -> `not` succeeds
+        exprs = []
+        statically_true = False
+        for f in finals:
+            conds = list(f.cond)
+            # inner guards beyond the outer ones participate in the inner
+            # truth value (an out-of-range element does not exist)
+            for ax, g in f.guards.items():
+                if st.guards.get(ax) is not g:
+                    conds.append(g)
+            if not conds:
+                statically_true = True
+                break
+            cond = conds[0]
+            for c in conds[1:]:
+                cond = e_and(cond, c)
+            # reduce axes opened inside the negation (e.g. the token axis
+            # of an annotations[key] join) back to the outer space
+            for ax in cond.space:
+                if ax not in st.space:
+                    cond = EReduceAxis(cond, ax, "any")
+            if any(ax not in cond.space for ax in st.space):
+                # outer axes missing from inner cond: broadcasting in the
+                # final AND handles it
+                pass
+            exprs.append(cond)
+        if statically_true:
+            return []  # inner always defined -> `not` fails
+        combined = exprs[0]
+        for e in exprs[1:]:
+            combined = e_or(combined, e)
+        return [replace(st, cond=st.cond + [e_not(combined)])]
+
+    # -- terms --------------------------------------------------------------
+
+    def _eval_term(self, term: A.Term, st: State) -> List[Tuple[SVal, State]]:
+        if isinstance(term, A.Scalar):
+            return [(SConst(term.value), st)]
+        if isinstance(term, A.Var):
+            if term.name in st.env:
+                return [(st.env[term.name], st)]
+            if term.name == "input":
+                return [(SInput(), st)]
+            if term.name in self.rules:
+                return self._eval_rule_ref(term.name, [], st)
+            raise CompileUnsupported(f"unbound var {term.name}")
+        if isinstance(term, A.Wildcard):
+            raise CompileUnsupported("wildcard term")
+        if isinstance(term, A.Ref):
+            return self._eval_ref(term, st)
+        if isinstance(term, A.Call):
+            return self._eval_call(term, st)
+        if isinstance(term, A.BinOp):
+            return self._eval_binop(term, st)
+        if isinstance(term, A.Comprehension):
+            return self._eval_comprehension(term, st)
+        if isinstance(term, A.ArrayTerm):
+            return self._eval_seq_literal(term.items, st, "array")
+        if isinstance(term, A.SetTerm):
+            return self._eval_seq_literal(term.items, st, "set")
+        if isinstance(term, A.ObjectTerm):
+            return self._eval_obj_literal(term, st)
+        if isinstance(term, A.UnaryMinus):
+            forks = self._eval_term(term.operand, st)
+            out = []
+            for v, s in forks:
+                if isinstance(v, SConst) and isinstance(v.value, (int, float)):
+                    out.append((SConst(-v.value), s))
+                else:
+                    raise CompileUnsupported("symbolic unary minus")
+            return out
+        raise CompileUnsupported(f"term {type(term).__name__}")
+
+    def _eval_seq_literal(self, items, st: State, kind: str):
+        vals, cur = [], st
+        symbolic = False
+        for item in items:
+            forks = self._eval_term(item, cur)
+            if not forks:
+                return []  # undefined element -> literal undefined
+            if len(forks) != 1:
+                raise CompileUnsupported("forking literal element")
+            v, cur = forks[0]
+            if not isinstance(v, SConst):
+                symbolic = True
+            vals.append(v)
+        if symbolic:
+            return [(SList([(None, v) for v in vals]), cur)]
+        pyvals = [v.value for v in vals]
+        if kind == "set":
+            return [(SConst(set(_hashable(x) for x in pyvals)), cur)]
+        return [(SConst(pyvals), cur)]
+
+    def _eval_obj_literal(self, term: A.ObjectTerm, st: State):
+        cur = st
+        concrete: Dict[Any, Any] = {}
+        symbolic = False
+        for k, v in term.items:
+            kf = self._eval_term(k, cur)
+            if len(kf) != 1:
+                raise CompileUnsupported("forking object key")
+            kv, cur = kf[0]
+            vf = self._eval_term(v, cur)
+            if len(vf) != 1:
+                raise CompileUnsupported("forking object value")
+            vv, cur = vf[0]
+            if isinstance(kv, SConst) and isinstance(vv, SConst):
+                concrete[_hashable(kv.value)] = vv.value
+            else:
+                symbolic = True
+        if symbolic:
+            sig_items = []
+            for k, v in term.items:
+                kf = self._eval_term(k, st)
+                kv = kf[0][0] if kf else None
+                vf = self._eval_term(v, st)
+                vv = vf[0][0] if vf else None
+                sig_items.append((_val_sig(kv), _val_sig(vv)))
+            return [(SMsg(sig=("obj", tuple(sig_items))), cur)]
+        return [(SConst(concrete), cur)]
+
+    # -- refs ---------------------------------------------------------------
+
+    def _eval_ref(self, ref: A.Ref, st: State):
+        if not isinstance(ref.head, A.Var):
+            raise CompileUnsupported("computed ref head")
+        name = ref.head.name
+        if name == "input":
+            if not ref.ops or not isinstance(ref.ops[0], A.Scalar):
+                raise CompileUnsupported("opaque input access")
+            first = ref.ops[0].value
+            if first == "parameters":
+                return self._walk(SConst(self.params), ref.ops[1:], st)
+            if first == "review":
+                return self._walk(SNode(prefix=()), ref.ops[1:], st)
+            raise CompileUnsupported(f"input.{first}")
+        if name in st.env:
+            return self._walk(st.env[name], ref.ops, st)
+        if name in self.rules:
+            return self._eval_rule_ref(name, ref.ops, st)
+        if name == "data":
+            raise CompileUnsupported("data ref (inventory) not compiled yet")
+        raise CompileUnsupported(f"unknown ref head {name}")
+
+    def _walk(self, val: SVal, ops: List[A.Term], st: State):
+        forks: List[Tuple[SVal, State]] = [(val, st)]
+        for op in ops:
+            nxt: List[Tuple[SVal, State]] = []
+            for v, s in forks:
+                nxt.extend(self._walk_one(v, op, s))
+            forks = nxt
+            if not forks:
+                return []
+        return forks
+
+    def _walk_one(self, val: SVal, op: A.Term, st: State):
+        if isinstance(val, SInput):
+            if isinstance(op, A.Scalar) and op.value == "parameters":
+                return [(SConst(self.params), st)]
+            if isinstance(op, A.Scalar) and op.value == "review":
+                return [(SNode(prefix=()), st)]
+            raise CompileUnsupported("opaque input walk")
+        if isinstance(val, SConst):
+            return self._walk_const(val.value, op, st)
+        if isinstance(val, SNode):
+            return self._walk_node(val, op, st)
+        if isinstance(val, (SScalar, SKey, SMsg, SDerived)):
+            # indexing into a leaf: undefined in Rego (object-branch values
+            # walked further also land here and contribute nothing)
+            return []
+        if isinstance(val, STokenSet):
+            if isinstance(op, (A.Var, A.Wildcard)) and not (
+                isinstance(op, A.Var) and op.name in st.env
+            ):
+                if val.axes:
+                    raise CompileUnsupported("iterating per-axis token set")
+                elem = SScalar(
+                    self,
+                    pattern_idx=-1,
+                    axes=(),
+                    tok_space=True,
+                    sel_override=val.mask,
+                    vid_override=val.elem_ids,
+                    exists_override=val.mask,
+                )
+                st2 = replace(st, space=_space_join(st.space, ("tok",)))
+                st2 = replace(st2, cond=st2.cond + [val.mask])
+                return [(elem, st2)]
+            raise CompileUnsupported("walking a comprehension result")
+        raise CompileUnsupported(f"walk {type(val).__name__}")
+
+    def _walk_const(self, value: Any, op: A.Term, st: State):
+        if isinstance(op, A.Scalar):
+            key = op.value
+            if isinstance(value, dict):
+                return [(SConst(value[key]), st)] if key in value else []
+            if isinstance(value, list):
+                if isinstance(key, (int, float)) and int(key) == key:
+                    i = int(key)
+                    return [(SConst(value[i]), st)] if 0 <= i < len(value) else []
+                return []
+            if isinstance(value, (set, frozenset)):
+                return [(SConst(key), st)] if _hashable(key) in value else []
+            return []
+        if isinstance(op, A.Var) and op.name in st.env:
+            kv = st.env[op.name]
+            if isinstance(kv, SConst):
+                return self._walk_const(value, A.Scalar(kv.value), st)
+            return self._lookup_symbolic(value, kv, st)
+        if isinstance(op, (A.Wildcard, A.Var)):
+            bind = op.name if isinstance(op, A.Var) else None
+            if isinstance(value, dict):
+                items = list(value.items())
+            elif isinstance(value, list):
+                items = list(enumerate(value))
+            elif isinstance(value, (set, frozenset)):
+                items = [(v, v) for v in value]
+            else:
+                return []
+            out = []
+            for k, v in items:
+                env = dict(st.env)
+                if bind:
+                    env[bind] = SConst(k)
+                out.append((SConst(v), replace(st, env=env)))
+            return out
+        raise CompileUnsupported("const walk op")
+
+    def _lookup_symbolic(self, container: Any, key: SVal, st: State):
+        """concrete_container[symbolic_key] — membership/lookup condition."""
+        if isinstance(container, (set, frozenset, dict, list)):
+            if isinstance(container, dict):
+                keys = list(container.keys())
+            elif isinstance(container, list):
+                keys = list(range(len(container)))
+            else:
+                keys = list(container)
+            str_keys = [k for k in keys if isinstance(k, str)]
+            if len(str_keys) != len(keys):
+                raise CompileUnsupported("non-string symbolic lookup keys")
+            ids = [self.vocab.str_id(k) for k in str_keys]
+            slot = self.pool.id_set(ids)
+            self.signature.append(("idset", len(self.pool.values[slot])))
+            if isinstance(key, SKey):
+                cond = EIsInConst(key.ids(), slot)
+            elif isinstance(key, SScalar) and key.num_override is None:
+                cond = e_and(key.exists(), EIsInConst(key.vid(), slot))
+            else:
+                raise CompileUnsupported("symbolic lookup key shape")
+            # the VALUE is only usable when all container values are equal
+            # or the result is used as a condition; return an opaque truthy
+            # value guarded by membership (values in these templates are
+            # `true` markers or the keys themselves)
+            st2 = replace(st, cond=st.cond + [cond])
+            vals = set(
+                _hashable(v)
+                for v in (
+                    container.values()
+                    if isinstance(container, dict)
+                    else container
+                )
+            )
+            if len(vals) == 1:
+                return [(SConst(next(iter(vals))), st2)]
+            return [(SMsg(), st2)]
+        return []
+
+    def _walk_node(self, node: SNode, op: A.Term, st: State):
+        if isinstance(op, A.Scalar):
+            if not isinstance(op.value, str):
+                return self._iterate_indexed(node, op, st)
+            if "*" in node.prefix:
+                raise CompileUnsupported("field access under object iteration")
+            return [(SNode(node.prefix + (esc_seg(op.value),)), st)]
+        if isinstance(op, A.Var) and op.name in st.env:
+            kv = st.env[op.name]
+            if isinstance(kv, SConst):
+                if isinstance(kv.value, str):
+                    return [(SNode(node.prefix + (esc_seg(kv.value),)), st)]
+                if kv.value is _ARRAY_INDEX:
+                    raise CompileUnsupported("array index used as key")
+                return []
+            if isinstance(kv, (SKey, SScalar)):
+                return self._iterate_keyed_bound(node, kv, st)
+            raise CompileUnsupported("bound node key shape")
+        if isinstance(op, (A.Wildcard, A.Var)):
+            return self._iterate_node(node, op, st)
+        raise CompileUnsupported("node walk op")
+
+    def _iterate_indexed(self, node: SNode, op: A.Scalar, st: State):
+        """containers[0] — fixed array index."""
+        idx = op.value
+        if not (isinstance(idx, (int, float)) and int(idx) == idx):
+            return []
+        raise CompileUnsupported("fixed array index")
+
+    def _iterate_keyed_bound(self, node: SNode, key: SVal, st: State):
+        """node[k] with k already bound to a symbolic key — equality join
+        between the capture and the bound key (labels[key] pattern)."""
+        if "*" in node.prefix or "#" in node.prefix:
+            raise CompileUnsupported("keyed join under iteration")
+        pat = self._pattern(node.prefix + ("*", "**"))
+        scalar = SScalar(self, pat, axes=(), tok_space=True)
+        if isinstance(key, SKey):
+            cond = e_cmp("==", ECapture(pat), key.ids())
+        elif isinstance(key, SScalar) and key.num_override is None:
+            cond = e_and(key.exists(), e_cmp("==", ECapture(pat), key.vid()))
+        else:
+            raise CompileUnsupported("keyed join key shape")
+        st2 = replace(
+            st,
+            cond=st.cond + [e_and(scalar.sel(), cond)],
+            space=_space_join(st.space, ("tok",)),
+        )
+        return [(scalar, st2)]
+
+    def _iterate_node(self, node: SNode, op: A.Term, st: State):
+        bind = op.name if isinstance(op, A.Var) else None
+        forks: List[Tuple[SVal, State]] = []
+        # array branch: extend with "#" (lazy axis)
+        if (
+            node.prefix.count("#") < 2
+            and "*" not in node.prefix
+            and "tok" not in st.space
+        ):
+            child = SNode(node.prefix + ("#",))
+            axes = _axes_of(child.prefix)
+            axis = axes[-1]
+            owner = st.axis_owner.get(axis)
+            if owner is not None and owner != node.prefix:
+                raise CompileUnsupported(
+                    f"two arrays on one axis: {owner} vs {node.prefix}"
+                )
+
+            guard_pat = self._pattern(child.prefix + ("**",))
+            guard = EGroupPresent(ESelPattern(guard_pat), axis)
+            guards = dict(st.guards)
+            guards[axis] = guard
+            owners = dict(st.axis_owner)
+            owners[axis] = node.prefix
+            env = dict(st.env)
+            if bind:
+                # the numeric index value: comparisons against it are
+                # statically false (no library template uses it)
+                env[bind] = SConst(_ARRAY_INDEX)
+            st2 = replace(
+                st,
+                env=env,
+                space=_space_join(st.space, axes),
+                guards=guards,
+                axis_owner=owners,
+            )
+            forks.append((child, st2))
+        # object branch: token axis over keys; allowed under an open array
+        # axis too (joins land on the rank-3 ("tok","g0") space)
+        if st.space in ((), ("g0",)):
+            pat = self._pattern(node.prefix + ("*", "**"))
+            scalar = SScalar(self, pat, axes=(), tok_space=True)
+            env = dict(st.env)
+            if bind:
+                env[bind] = SKey(pat)
+            st2 = replace(
+                st,
+                env=env,
+                space=_space_join(st.space, ("tok",)),
+                cond=st.cond + [scalar.truthy()],
+            )
+            forks.append((scalar, st2))
+        if not forks:
+            if "tok" in st.space:
+                # we're inside the phantom object-branch of an earlier
+                # iteration (real data there is an array, matched by the
+                # sibling fork): this fork contributes nothing
+                return []
+            raise CompileUnsupported("iteration not representable")
+        return forks
+
+    def _node_leaf(self, node: SNode) -> SScalar:
+        if "*" in node.prefix:
+            raise CompileUnsupported("leaf under object iteration")
+        pat = self._pattern(node.prefix)
+        return SScalar(self, pat, axes=_axes_of(node.prefix))
+
+    def _eval_rule_ref(self, name: str, ops: List[A.Term], st: State):
+        rules = self.rules[name]
+        kind = rules[0].head.kind
+        if kind == "set":
+            if not ops:
+                raise CompileUnsupported("bare partial-set ref as value")
+            out: List[Tuple[SVal, State]] = []
+            for rule in rules:
+                for v, s in self._iterate_partial_set(rule, ops[0], st):
+                    out.extend(self._walk(v, ops[1:], s))
+            return out
+        if kind == "complete":
+            if len(rules) == 1 and not rules[0].is_default:
+                rule = rules[0]
+                if not rule.body:
+                    forks = self._eval_term(rule.head.value, st)
+                else:
+                    # computed complete rule (requiredprobes' probe_type_set):
+                    # compile only when the body resolves concretely
+                    sub = State(env={})
+                    finals = self._eval_body(rule.body, sub)
+                    if len(finals) != 1 or finals[0].cond or finals[0].space:
+                        raise CompileUnsupported("computed complete rule")
+                    forks = self._eval_term(rule.head.value, finals[0])
+                    forks = [(v, st) for v, _ in forks]
+                out = []
+                for v, s in forks:
+                    out.extend(self._walk(v, ops, s))
+                return out
+            raise CompileUnsupported("computed complete rule ref")
+        raise CompileUnsupported(f"rule ref {kind}")
+
+    def _iterate_partial_set(self, rule: A.Rule, op: A.Term, st: State):
+        """Iterate/match a same-module partial set rule.
+
+        Object-literal operands (the containerlimits
+        `general_violation[{"msg": msg, "field": "containers"}]` pattern)
+        unify field-by-field with an object-literal head key: caller-side
+        constants PRE-BIND the head's variables before the body runs,
+        caller-side unbound variables bind from the head afterwards.
+        """
+        pre_env: Dict[str, SVal] = {}
+        post_binds: List[Tuple[str, A.Term]] = []
+        if isinstance(op, A.ObjectTerm):
+            if not isinstance(rule.head.key, A.ObjectTerm):
+                return []
+            head_map = {}
+            for hk, hval in rule.head.key.items:
+                if not isinstance(hk, A.Scalar):
+                    raise CompileUnsupported("computed head key field")
+                head_map[hk.value] = hval
+            if set(head_map) != {
+                k.value for k, _ in op.items if isinstance(k, A.Scalar)
+            } or len(op.items) != len(head_map):
+                return []  # field sets differ: no match
+            for k, v in op.items:
+                hterm = head_map[k.value]
+                if isinstance(v, A.Var) and v.name not in st.env:
+                    post_binds.append((v.name, hterm))
+                    continue
+                if isinstance(v, A.Wildcard):
+                    continue
+                vf = self._eval_term(v, st)
+                if len(vf) != 1 or not isinstance(vf[0][0], SConst):
+                    raise CompileUnsupported("non-const pattern field")
+                cv = vf[0][0]
+                if isinstance(hterm, A.Var):
+                    pre_env[hterm.name] = cv
+                elif isinstance(hterm, A.Scalar):
+                    if hterm.value != cv.value:
+                        return []  # statically mismatched clause
+                else:
+                    raise CompileUnsupported("head field shape")
+        elif not isinstance(op, (A.Var, A.Wildcard)):
+            raise CompileUnsupported("partial-set operand shape")
+
+        sub = State(env=pre_env, space=st.space, guards=dict(st.guards), axis_owner=dict(st.axis_owner))
+        finals = self._eval_body(rule.body, sub)
+        out = []
+        for f in finals:
+            for hv, hs in self._eval_term(rule.head.key, f):
+                merged = replace(
+                    st,
+                    cond=st.cond + hs.cond,
+                    space=hs.space,
+                    guards=hs.guards,
+                    axis_owner=hs.axis_owner,
+                )
+                env = dict(merged.env)
+                if isinstance(op, A.Var) and op.name not in st.env:
+                    env[op.name] = hv
+                for var_name, hterm in post_binds:
+                    bf = self._eval_term(hterm, hs)
+                    if len(bf) != 1:
+                        raise CompileUnsupported("forking head field")
+                    env[var_name] = bf[0][0]
+                merged = replace(merged, env=env)
+                out.append((hv, merged))
+        return out
+
+    # -- calls --------------------------------------------------------------
+
+    def _eval_call(self, call: A.Call, st: State):
+        arg_forks: List[Tuple[List[SVal], State]] = [([], st)]
+        for arg in call.args:
+            nxt = []
+            for vals, s in arg_forks:
+                for v, s2 in self._eval_term(arg, s):
+                    if isinstance(v, SNode):
+                        # call operands are evaluated before the call:
+                        # undefined args make the whole call undefined
+                        s2 = replace(
+                            s2,
+                            cond=s2.cond + [self._node_exists_cond(v)],
+                        )
+                    nxt.append((vals + [v], s2))
+            arg_forks = nxt
+        out: List[Tuple[SVal, State]] = []
+        for vals, s in arg_forks:
+            out.extend(self._apply_call(call.name, vals, s))
+        return out
+
+    def _apply_call(self, name: str, args: List[SVal], st: State):
+        if name in self.rules:
+            return self._inline_function(name, args, st)
+        handler = getattr(self, f"_builtin_{name.replace('.', '_')}", None)
+        if handler is not None:
+            return handler(args, st)
+        if all(isinstance(a, SConst) for a in args):
+            from ..rego.builtins import BUILTINS, BuiltinError
+            from ..rego.values import freeze, thaw
+
+            if name in BUILTINS:
+                arity, fn = BUILTINS[name]
+                if arity != len(args):
+                    raise CompileUnsupported(f"{name} arity")
+                try:
+                    v = fn(*[freeze(a.value) for a in args])
+                except BuiltinError:
+                    return []
+                return [(SConst(thaw(v)), st)]
+        raise CompileUnsupported(f"builtin {name} symbolic")
+
+    def _inline_function(self, name: str, args: List[SVal], st: State):
+        if self._fn_depth > 8:
+            raise CompileUnsupported("inline depth")
+        rules = self.rules[name]
+        if rules[0].head.kind != "func":
+            raise CompileUnsupported(f"{name} not a function")
+        try:
+            return self._inline_function_body(name, rules, args, st)
+        except CompileUnsupported:
+            # fall back to per-vocab-entry tableization for pure scalar
+            # helpers (canonify_cpu & co)
+            tabled = self._tableize_function(name, args, st)
+            if tabled is not None:
+                return tabled
+            raise
+
+    def _inline_function_body(
+        self, name: str, rules: List[A.Rule], args: List[SVal], st: State
+    ):
+        self._fn_depth += 1
+        try:
+            out: List[Tuple[SVal, State]] = []
+            for rule in rules:
+                formals = rule.head.args or []
+                if len(formals) != len(args):
+                    continue
+                sub = State(env={}, space=st.space, guards=dict(st.guards), axis_owner=dict(st.axis_owner))
+                ok = True
+                for formal, actual in zip(formals, args):
+                    if isinstance(formal, A.Var):
+                        sub.env[formal.name] = actual
+                    elif isinstance(formal, A.Wildcard):
+                        continue
+                    elif isinstance(formal, A.Scalar):
+                        if isinstance(actual, SConst):
+                            if actual.value != formal.value:
+                                ok = False
+                                break
+                        else:
+                            cond, okk = self._sym_eq(
+                                actual, SConst(formal.value)
+                            )
+                            if not okk:
+                                raise CompileUnsupported("formal pattern")
+                            sub.cond.append(cond)
+                    else:
+                        raise CompileUnsupported("formal pattern shape")
+                if not ok:
+                    continue
+                finals = self._eval_body(rule.body, sub)
+                for f in finals:
+                    vf = (
+                        self._eval_term(rule.head.value, f)
+                        if rule.head.value is not None
+                        else [(SConst(True), f)]
+                    )
+                    for hv, hs in vf:
+                        merged = replace(
+                            st,
+                            cond=st.cond + hs.cond,
+                            space=hs.space,
+                            guards=hs.guards,
+                        )
+                        out.append((hv, merged))
+            return out
+        finally:
+            self._fn_depth -= 1
+
+    def _tableize_function(self, name: str, args: List[SVal], st: State):
+        """Pure single-scalar-arg helper -> per-vocab-entry value table."""
+        if self.cenv.oracle_fn is None or len(args) != 1:
+            return None
+        arg = self._leafify(args[0])
+        if not isinstance(arg, (SScalar, SKey)):
+            return None
+        if isinstance(arg, SScalar) and arg.num_override is not None:
+            return None
+        if not self._fn_is_pure(name, set()):
+            return None
+        if not self._fn_arg_scalar(name):
+            return None
+        oracle = self.cenv.oracle_fn
+        tname = self.tables.register(
+            f"fn:{self.cenv.oracle_ns}:{name}",
+            lambda v, _n=name, _o=oracle: _numeric_oracle(_o, _n, v),
+            dtype="float64",
+        )
+        self.signature.append(("table", tname))
+        if isinstance(arg, SScalar):
+            ids = arg.vid()
+            base_def = arg.exists()
+        else:
+            ids = arg.ids()
+            base_def = e_cmp("!=", arg.ids(), ELit(-1))
+        num = EStrTable(tname, ids, default=0.0)
+        dfn = e_and(base_def, EStrTable(tname + "!def", ids, default=False))
+        return [(SDerived(num=num, defined=dfn), st)]
+
+    def _fn_arg_scalar(self, name: str) -> bool:
+        """True if the function only uses its formals as scalars (never
+        walks into them) — required for vid-keyed tableization."""
+        for rule in self.rules.get(name, []):
+            formals = {
+                f.name for f in (rule.head.args or []) if isinstance(f, A.Var)
+            }
+            bad = []
+
+            def visit(node):
+                if (
+                    isinstance(node, A.Ref)
+                    and isinstance(node.head, A.Var)
+                    and node.head.name in formals
+                    and node.ops
+                ):
+                    bad.append(node.head.name)
+
+            import dataclasses as _dc
+
+            def walk(n):
+                if isinstance(n, A.Node):
+                    visit(n)
+                    for f in _dc.fields(n):
+                        walk(getattr(n, f.name))
+                elif isinstance(n, (list, tuple)):
+                    for x in n:
+                        walk(x)
+
+            walk(rule)
+            if bad:
+                return False
+        return True
+
+    def _fn_is_pure(self, name: str, seen: set) -> bool:
+        """No input.review / data refs anywhere in the call graph
+        (input.parameters is concrete and allowed)."""
+        if name in seen:
+            return True
+        seen.add(name)
+        from ..constraint.regocompile import walk_module as _walk_rules
+
+        impure = []
+
+        def visit(node):
+            if isinstance(node, A.Ref) and isinstance(node.head, A.Var):
+                if node.head.name == "data":
+                    impure.append("data")
+                if node.head.name in self.rules and not self._fn_is_pure(
+                    node.head.name, seen
+                ):
+                    impure.append(node.head.name)
+                if node.head.name == "input":
+                    if (
+                        node.ops
+                        and isinstance(node.ops[0], A.Scalar)
+                        and node.ops[0].value == "parameters"
+                    ):
+                        return
+                    impure.append("input")
+            if isinstance(node, A.Call):
+                base = node.name.split(".")[-1] if "." in node.name else node.name
+                if base in self.rules and not self._fn_is_pure(base, seen):
+                    impure.append(base)
+
+        import dataclasses as _dc
+
+        def walk(n):
+            if isinstance(n, A.Node):
+                visit(n)
+                for f in _dc.fields(n):
+                    walk(getattr(n, f.name))
+            elif isinstance(n, (list, tuple)):
+                for x in n:
+                    walk(x)
+
+        for rule in self.rules.get(name, []):
+            walk(rule)
+        return not impure
+
+    # -- binops -------------------------------------------------------------
+
+    def _eval_binop(self, term: A.BinOp, st: State):
+        out = []
+        for lv, s1 in self._eval_term(term.lhs, st):
+            for rv, s2 in self._eval_term(term.rhs, s1):
+                r = self._apply_binop(term.op, lv, rv, s2)
+                if r is not None:
+                    out.append(r)
+        return out
+
+    def _apply_binop(self, op: str, lv: SVal, rv: SVal, st: State):
+        if isinstance(lv, SConst) and isinstance(rv, SConst):
+            return self._const_binop(op, lv, rv, st)
+        if op in ("==", "!=", "<", "<=", ">", ">="):
+            return self._sym_cmp(op, lv, rv, st)
+        if op in ("+", "-", "*", "/", "%"):
+            if op == "-" and isinstance(lv, (SConst, STokenSet)) and (
+                isinstance(rv, (SConst, STokenSet))
+            ):
+                maybe = self._set_difference(lv, rv, st)
+                if maybe is not None:
+                    return maybe
+            return self._sym_arith(op, lv, rv, st)
+        if op in ("&", "|"):
+            raise CompileUnsupported("symbolic set intersection/union")
+        raise CompileUnsupported(f"binop {op}")
+
+    def _const_binop(self, op: str, lv: SConst, rv: SConst, st: State):
+        from ..rego.values import freeze, rego_cmp
+
+        if lv.value is _ARRAY_INDEX or rv.value is _ARRAY_INDEX:
+            # array-index binding compared to a concrete value: unknown
+            # number vs (usually) string — only == / != are decidable when
+            # the other side is not a number
+            other = rv.value if lv.value is _ARRAY_INDEX else lv.value
+            if op == "==" and not isinstance(other, (int, float)):
+                return (SConst(False), st)
+            if op == "!=" and not isinstance(other, (int, float)):
+                return (SConst(True), st)
+            raise CompileUnsupported("comparison with array index")
+        if op in ("==", "!=", "<", "<=", ">", ">="):
+            c = rego_cmp(freeze(lv.value), freeze(rv.value))
+            res = {
+                "==": c == 0,
+                "!=": c != 0,
+                "<": c < 0,
+                "<=": c <= 0,
+                ">": c > 0,
+                ">=": c >= 0,
+            }[op]
+            return (SConst(res), st)
+        a, b = lv.value, rv.value
+        if isinstance(a, (set, frozenset)) and isinstance(b, (set, frozenset)):
+            res = {"-": a - b, "&": a & b, "|": a | b}.get(op)
+            if res is None:
+                raise CompileUnsupported("const set op")
+            return (SConst(res), st)
+        if (
+            isinstance(a, (int, float))
+            and isinstance(b, (int, float))
+            and not isinstance(a, bool)
+            and not isinstance(b, bool)
+        ):
+            if op in ("/", "%") and b == 0:
+                return None
+            res = {
+                "+": a + b,
+                "-": a - b,
+                "*": a * b,
+                "/": a / b if b != 0 else None,
+                "%": a % b if b != 0 else None,
+            }[op]
+            return (SConst(res), st)
+        raise CompileUnsupported("const binop types")
+
+    def _set_difference(self, lv: SVal, rv: SVal, st: State):
+        """Set difference where at least one side is token-derived."""
+        if isinstance(lv, SConst) and isinstance(rv, STokenSet):
+            if not isinstance(lv.value, (set, frozenset)):
+                return None
+            elems = [v for v in lv.value]
+            if not all(_is_scalar_const(v) for v in elems):
+                raise CompileUnsupported("const set of composites")
+            # count(missing) = #elems whose id never appears in the token set
+            self.signature.append(("constdiff", len(elems)))
+            if not elems:
+                return (SDerived(num=EFullN(0), defined=ELit(True)), st)
+            terms = []
+            for v in elems:
+                vid = self.vocab.val_id(_norm_num(v))
+                slot = self.pool.id_scalar(vid)
+                present = rv.reduce_any(e_cmp("==", rv.elem_ids, slot))
+                terms.append(
+                    EMap(
+                        lambda np_, p: (~p).astype(np.int32), [present], "miss"
+                    )
+                )
+            cnt = terms[0]
+            for t in terms[1:]:
+                cnt = e_arith("+", cnt, t)
+            return (SDerived(num=cnt, defined=ELit(True)), st)
+        if isinstance(lv, STokenSet) and isinstance(rv, SConst):
+            if not isinstance(rv.value, (set, frozenset)):
+                return None
+            elems = [v for v in rv.value if _is_scalar_const(v)]
+            ids = [self.vocab.val_id(_norm_num(v)) for v in elems]
+            slot = self.pool.id_set(ids)
+            self.signature.append(("idset", len(self.pool.values[slot])))
+            mask = e_and(lv.mask, e_not(EIsInConst(lv.elem_ids, slot)))
+            return (STokenSet(mask, lv.elem_ids, lv.axes), st)
+        if isinstance(lv, STokenSet) and isinstance(rv, STokenSet):
+            raise CompileUnsupported("token-set minus token-set")
+        return None
+
+    def _sym_arith(self, op: str, lv: SVal, rv: SVal, st: State):
+        ln, rn = self._as_num(lv), self._as_num(rv)
+        if ln is None or rn is None:
+            raise CompileUnsupported("non-numeric arithmetic")
+        val = e_arith(op, ln[0], rn[0])
+        defined = e_and(ln[1], rn[1])
+        if op in ("/", "%"):
+            defined = e_and(defined, e_cmp("!=", rn[0], ELit(0.0)))
+        return (SDerived(num=val, defined=defined), st)
+
+    def _as_num(self, v: SVal):
+        v = self._leafify(v)
+        if isinstance(v, SConst):
+            if isinstance(v.value, bool) or not isinstance(
+                v.value, (int, float)
+            ):
+                return None
+            slot = self.pool.scalar(float(v.value))
+            self.signature.append(("num",))
+            return (slot, ELit(True))
+        if isinstance(v, SDerived):
+            return (v.num, v.defined)
+        if isinstance(v, SScalar):
+            if v.num_override is not None:
+                return (v.num_override, v.exists())
+            return (
+                v.num(),
+                e_and(v.exists(), e_cmp("==", v.kindv(), ELit(K_NUM))),
+            )
+        return None
+
+    def _sym_eq(self, lv: SVal, rv: SVal) -> Tuple[Expr, bool]:
+        lv, rv = self._leafify(lv), self._leafify(rv)
+        if isinstance(lv, SConst) and not isinstance(rv, SConst):
+            lv, rv = rv, lv
+        if isinstance(rv, SConst):
+            cv = rv.value
+            if isinstance(lv, SDerived):
+                if isinstance(cv, bool) or not isinstance(cv, (int, float)):
+                    return ELit(False), True
+                slot = self.pool.scalar(float(cv))
+                self.signature.append(("num",))
+                return e_and(lv.defined, e_cmp("==", lv.num, slot)), True
+            if isinstance(lv, SScalar):
+                if lv.num_override is not None:
+                    if isinstance(cv, bool) or not isinstance(
+                        cv, (int, float)
+                    ):
+                        return ELit(False), True
+                    slot = self.pool.scalar(float(cv))
+                    self.signature.append(("num",))
+                    return (
+                        e_and(
+                            lv.exists(),
+                            e_cmp("==", lv.num_override, slot),
+                        ),
+                        True,
+                    )
+                if _is_scalar_const(cv):
+                    slot = self.pool.id_scalar(
+                        self.vocab.val_id(_norm_num(cv))
+                    )
+                    self.signature.append(("id",))
+                    return (
+                        e_and(lv.exists(), e_cmp("==", lv.vid(), slot)),
+                        True,
+                    )
+                return ELit(False), True
+            if isinstance(lv, SKey):
+                if isinstance(cv, str):
+                    slot = self.pool.id_scalar(self.vocab.str_id(cv))
+                    self.signature.append(("id",))
+                    return e_cmp("==", lv.ids(), slot), True
+                return ELit(False), True
+            raise CompileUnsupported("eq const shape")
+        if isinstance(lv, SKey) and isinstance(rv, SScalar):
+            lv, rv = rv, lv
+        if isinstance(lv, SScalar) and isinstance(rv, SKey):
+            return (
+                e_and(
+                    e_and(lv.exists(), e_cmp("==", lv.kindv(), ELit(K_STR))),
+                    e_cmp("==", lv.vid(), rv.ids()),
+                ),
+                True,
+            )
+        if isinstance(lv, SKey) and isinstance(rv, SKey):
+            return e_cmp("==", lv.ids(), rv.ids()), True
+        if isinstance(lv, SScalar) and isinstance(rv, SScalar):
+            if lv.num_override is None and rv.num_override is None:
+                return (
+                    e_and(
+                        e_and(lv.exists(), rv.exists()),
+                        e_cmp("==", lv.vid(), rv.vid()),
+                    ),
+                    True,
+                )
+        ln, rn = self._as_num(lv), self._as_num(rv)
+        if ln and rn:
+            return (
+                e_and(e_and(ln[1], rn[1]), e_cmp("==", ln[0], rn[0])),
+                True,
+            )
+        return ELit(False), False
+
+    def _sym_cmp(self, op: str, lv: SVal, rv: SVal, st: State):
+        lv, rv = self._leafify(lv), self._leafify(rv)
+        if op in ("==", "!="):
+            cond, ok = self._sym_eq(lv, rv)
+            if not ok:
+                raise CompileUnsupported("eq shapes")
+            if op == "!=":
+                defs = []
+                for v in (lv, rv):
+                    if isinstance(v, SScalar):
+                        defs.append(v.exists())
+                    elif isinstance(v, SDerived):
+                        defs.append(v.defined)
+                cond = e_not(cond)
+                for d in defs:
+                    cond = e_and(cond, d)
+            return (SBool(cond), st)
+        ln, rn = self._as_num(lv), self._as_num(rv)
+        if ln and rn:
+            return (SBool(e_and(e_and(ln[1], rn[1]), e_cmp(op, ln[0], rn[0]))), st)
+        if (
+            isinstance(lv, SScalar)
+            and lv.num_override is None
+            and isinstance(rv, SConst)
+            and isinstance(rv.value, str)
+        ):
+            tname = self.tables.register(
+                f"cmp{op}:{rv.value}",
+                lambda s, _c=rv.value, _o=op: (
+                    {"<": s < _c, "<=": s <= _c, ">": s > _c, ">=": s >= _c}[
+                        _o
+                    ],
+                    True,
+                ),
+                dtype=bool,
+            )
+            self.signature.append(("table", tname))
+            cond = e_and(
+                e_and(
+                    lv.exists(), e_cmp("==", lv.kindv(), ELit(K_STR))
+                ),
+                EStrTable(tname, lv.vid()),
+            )
+            return (SBool(cond), st)
+        raise CompileUnsupported(f"cmp {op} shapes")
+
+    # -- conditions ---------------------------------------------------------
+
+    def _eval_cond_term(self, term: A.Term, st: State) -> List[State]:
+        out = []
+        for v, s in self._eval_term(term, st):
+            c = self._truthiness(v, s)
+            if c is None:
+                continue
+            if c is True:
+                out.append(s)
+            else:
+                out.append(replace(s, cond=s.cond + [c]))
+        return out
+
+    def _truthiness(self, v: SVal, st: State):
+        if isinstance(v, SConst):
+            return True if v.value is not False else None
+        if isinstance(v, SBool):
+            return v.expr
+        if isinstance(v, SDerived):
+            return v.defined
+        if isinstance(v, SScalar):
+            return v.truthy()
+        if isinstance(v, SNode):
+            return self._node_truthy(v)
+        if isinstance(v, (SMsg, SKey, STokenSet, SList)):
+            return True
+        raise CompileUnsupported(f"truthiness {type(v).__name__}")
+
+    def _node_truthy(self, node: SNode) -> Expr:
+        """Node exists and is not the literal false."""
+        if "*" in node.prefix:
+            raise CompileUnsupported("node truthy under object iteration")
+        deep = self._pattern(node.prefix + ("**",))
+        axes = _axes_of(node.prefix)
+        exact = self._pattern(node.prefix)
+        false_id = ELit(self.false_id)
+        sel_deep = ESelPattern(deep)
+        sel_exact = ESelPattern(exact)
+        is_false_leaf = e_and(
+            sel_exact, e_cmp("==", ETokCol("vid"), false_id)
+        )
+        good = e_and(sel_deep, e_not(is_false_leaf))
+        if not axes:
+            return EReduce(good, "any")
+        if axes in (("g0",), ("g01",)):
+            return EGroup(good, None, axes[0], how="any")
+        raise CompileUnsupported("node truthy axes")
+
+    # -- comprehensions ------------------------------------------------------
+
+    def _eval_comprehension(self, term: A.Comprehension, st: State):
+        """Set/array comprehension.
+
+        The body evaluates in the OUTER state (bindings like `container`
+        stay live); axes already open outside remain the set's grouping
+        axes, axes/token-selections opened inside become the set's element
+        dimension.
+        """
+        if term.kind == "object":
+            raise CompileUnsupported("object comprehension")
+        sub = State(env=dict(st.env), space=st.space, guards=dict(st.guards), axis_owner=dict(st.axis_owner))
+        finals = self._eval_body(term.body, sub)
+        if not finals:
+            if term.kind == "set":
+                return [(SConst(set()), st)]
+            return [(SConst([]), st)]
+        # concrete-iteration comprehension (possibly with symbolic heads
+        # and per-fork guards, e.g. allowedrepos' [good | repo =
+        # params.repos[_]; good = startswith(container.image, repo)])
+        if all(
+            f.space == st.space and f.guards == st.guards for f in finals
+        ):
+            vals: List[Tuple[Optional[Expr], SVal]] = []
+            for f in finals:
+                guard: Optional[Expr] = None
+                extra = [c for c in f.cond if c not in st.cond]
+                for c in extra:
+                    guard = c if guard is None else e_and(guard, c)
+                for hv, hs in self._eval_term(term.head, f):
+                    vals.append((guard, hv))
+            if all(g is None and isinstance(v, SConst) for g, v in vals):
+                elems = [v.value for _, v in vals]
+                if term.kind == "set":
+                    return [(SConst(set(_hashable(e) for e in elems)), st)]
+                return [(SConst(elems), st)]
+            if all(isinstance(v, (SConst, SBool)) for _, v in vals):
+                return [(SList(vals), st)]
+        outer_axes = tuple(a for a in st.space if a in ("g0", "g1"))
+        pieces: List[Tuple[Expr, Expr]] = []  # (mask, elem_ids)
+        for f in finals:
+            hf = self._eval_term(term.head, f)
+            for hv, hs in hf:
+                if isinstance(hv, SNode):
+                    hv = self._node_leaf(hv)
+                if isinstance(hv, SConst) and hv.value is _ARRAY_INDEX:
+                    # array-iteration indices as elements: numeric indices
+                    # never collide with interned string/value ids, so this
+                    # branch's contribution to set algebra is empty
+                    continue
+                inner_conds = list(hs.cond)
+                if isinstance(hv, SKey):
+                    mask: Expr = ESelPattern(hv.pattern_idx)
+                    elem: Expr = hv.ids()
+                elif isinstance(hv, SScalar) and hv.tok_space:
+                    mask = hv.sel()
+                    elem = ETokCol("vid")
+                elif (
+                    isinstance(hv, SScalar)
+                    and hv.num_override is None
+                    and hv.pattern_idx >= 0
+                ):
+                    # valid shapes: elements one or two array levels below
+                    # the outer binding — idx0-grouping covers both since
+                    # the first array level IS the outer axis
+                    ok = (
+                        not outer_axes
+                        or (
+                            outer_axes == ("g0",)
+                            and hv.axes in (("g0",), ("g01",))
+                        )
+                    )
+                    if not ok:
+                        raise CompileUnsupported("comprehension axis mismatch")
+                    mask = hv.sel()
+                    elem = ETokCol("vid")
+                else:
+                    raise CompileUnsupported("comprehension head shape")
+                for c in inner_conds:
+                    if c.space not in ((), ("tok",)):
+                        raise CompileUnsupported("comprehension cond space")
+                    mask = e_and(mask, c)
+                pieces.append((mask, elem))
+        if not pieces:
+            return [(SConst(set() if term.kind == "set" else []), st)]
+        if len(pieces) == 1:
+            return [(STokenSet(pieces[0][0], pieces[0][1], outer_axes), st)]
+        # union of branches: token selections over the same [N, L] space
+        # are disjoint per token, so elem ids can be merged positionally
+        mask = pieces[0][0]
+        for m, _ in pieces[1:]:
+            mask = e_or(mask, m)
+        elem = pieces[0][1]
+        for m, e in pieces[1:]:
+            elem = e_where(m, e, elem)
+        return [(STokenSet(mask, elem, outer_axes), st)]
+
+    # -- builtins ------------------------------------------------------------
+
+    def _builtin_count(self, args: List[SVal], st: State):
+        (v,) = args
+        if isinstance(v, SConst):
+            try:
+                return [(SConst(len(v.value)), st)]
+            except TypeError:
+                return []
+        if isinstance(v, STokenSet):
+            return [(SDerived(num=v.reduce_count(), defined=ELit(True)), st)]
+        if isinstance(v, SDerived):
+            return [(v, st)]  # const-diff counts are already numbers
+        if isinstance(v, SList):
+            if all(g is None for g, _ in v.items):
+                return [(SConst(len(v.items)), st)]
+            terms = []
+            for g, _ in v.items:
+                if g is None:
+                    terms.append(EFullN(1))
+                else:
+                    terms.append(
+                        EMap(lambda np_, c: c.astype(np.int32), [g], "toint")
+                    )
+            cnt = terms[0]
+            for t in terms[1:]:
+                cnt = e_arith("+", cnt, t)
+            return [(SDerived(num=cnt, defined=ELit(True)), st)]
+        if isinstance(v, SNode):
+            # count of an abstract node: number of ARRAY elements (distinct
+            # indices present). Exact for arrays — the library's only
+            # count-of-document usage (tls lists etc.); object/string counts
+            # are not compiled.
+            if "*" in v.prefix:
+                raise CompileUnsupported("count under object iteration")
+            child = v.prefix + ("#", "**")
+            axes = _axes_of(child)
+            pat = self._pattern(child)
+            present = EGroupPresent(ESelPattern(pat), axes[-1])
+            if len(axes) > 1:
+                raise CompileUnsupported("count of nested array")
+            cnt = EReduce(
+                EMap(
+                    lambda np_, p: p.astype(np.int32), [present], "toint"
+                ),
+                "sum",
+            )
+            # defined only when the node IS an array (has elements or is
+            # the empty-array token) or... count of undefined is undefined;
+            # count of {} / "" is 0. Approximation: defined iff node exists.
+            deep = self._pattern(v.prefix + ("**",))
+            exists = EReduce(ESelPattern(deep), "any")
+            return [(SDerived(num=cnt, defined=exists), st)]
+        raise CompileUnsupported("count arg")
+
+    def _builtin_any(self, args: List[SVal], st: State):
+        (v,) = args
+        if isinstance(v, SConst):
+            try:
+                return [(SConst(any(x is True for x in v.value)), st)]
+            except TypeError:
+                return []
+        if isinstance(v, SList):
+            exprs = []
+            for guard, item in v.items:
+                if isinstance(item, SConst):
+                    if item.value is True:
+                        if guard is None:
+                            return [(SConst(True), st)]
+                        exprs.append(guard)
+                elif isinstance(item, SBool):
+                    e = item.expr if guard is None else e_and(guard, item.expr)
+                    exprs.append(e)
+            if not exprs:
+                return [(SConst(False), st)]
+            out = exprs[0]
+            for e in exprs[1:]:
+                out = e_or(out, e)
+            return [(SBool(out), st)]
+        if isinstance(v, STokenSet):
+            # any over a token-set of booleans: true iff the set contains
+            # the literal true
+            true_slot = self.pool.id_scalar(self.vocab.val_id(True))
+            self.signature.append(("id",))
+            return [
+                (
+                    SBool(
+                        v.reduce_any(e_cmp("==", v.elem_ids, true_slot))
+                    ),
+                    st,
+                )
+            ]
+        raise CompileUnsupported("any arg")
+
+    def _builtin_all(self, args: List[SVal], st: State):
+        (v,) = args
+        if isinstance(v, SConst):
+            try:
+                return [(SConst(all(x is True for x in v.value)), st)]
+            except TypeError:
+                return []
+        if isinstance(v, SList):
+            exprs = []
+            for guard, item in v.items:
+                if isinstance(item, SConst):
+                    if item.value is not True:
+                        if guard is None:
+                            return [(SConst(False), st)]
+                        exprs.append(e_not(guard))
+                elif isinstance(item, SBool):
+                    e = item.expr if guard is None else e_or(e_not(guard), item.expr)
+                    exprs.append(e)
+            if not exprs:
+                return [(SConst(True), st)]
+            out = exprs[0]
+            for e in exprs[1:]:
+                out = e_and(out, e)
+            return [(SBool(out), st)]
+        raise CompileUnsupported("all arg")
+
+    def _builtin_re_match(self, args, st: State):
+        pat, target = args
+        if not isinstance(pat, SConst) or not isinstance(pat.value, str):
+            raise CompileUnsupported("symbolic regex pattern")
+        if isinstance(target, SConst):
+            import re as _re
+
+            if not isinstance(target.value, str):
+                return []
+            try:
+                return [
+                    (
+                        SConst(
+                            _re.search(pat.value, target.value) is not None
+                        ),
+                        st,
+                    )
+                ]
+            except _re.error:
+                return []
+        tname = self.tables.regex(pat.value)
+        self.signature.append(("table", tname))
+        ids, defined = self._string_ids(target)
+        return [(SBool(e_and(defined, EStrTable(tname, ids))), st)]
+
+    def _builtin_startswith(self, args, st):
+        return self._strpred(args, st, self.tables.prefix, lambda s, p: s.startswith(p))
+
+    def _builtin_endswith(self, args, st):
+        return self._strpred(args, st, self.tables.suffix, lambda s, p: s.endswith(p))
+
+    def _builtin_contains(self, args, st):
+        return self._strpred(args, st, self.tables.contains, lambda s, p: p in s)
+
+    def _strpred(self, args, st, mk, concrete):
+        target, pat = args
+        if not isinstance(pat, SConst) or not isinstance(pat.value, str):
+            raise CompileUnsupported("symbolic string-pred arg")
+        if isinstance(target, SConst):
+            if not isinstance(target.value, str):
+                return []
+            return [(SConst(concrete(target.value, pat.value)), st)]
+        tname = mk(pat.value)
+        self.signature.append(("table", tname))
+        ids, defined = self._string_ids(target)
+        return [(SBool(e_and(defined, EStrTable(tname, ids))), st)]
+
+    def _str_transform(self, v, st, name, fn):
+        v = self._leafify(v)
+        if isinstance(v, SConst):
+            if not isinstance(v.value, str):
+                return []
+            return [(SConst(fn(v.value)), st)]
+        ids, defined = self._string_ids(v)
+        tname = self.tables.str_transform(name, fn)
+        self.signature.append(("table", tname))
+        out_ids = EStrTable(tname, ids, default=-1)
+        space = ids.space
+        return [
+            (
+                SScalar(
+                    self,
+                    pattern_idx=-1,
+                    axes=space if space != ("tok",) else (),
+                    tok_space=space == ("tok",),
+                    vid_override=out_ids,
+                    exists_override=defined,
+                ),
+                st,
+            )
+        ]
+
+    def _builtin_lower(self, args, st):
+        return self._str_transform(args[0], st, "lower", lambda x: x.lower())
+
+    def _builtin_upper(self, args, st):
+        return self._str_transform(args[0], st, "upper", lambda x: x.upper())
+
+    def _builtin_trim(self, args, st):
+        target, cutset = args
+        if not isinstance(cutset, SConst) or not isinstance(cutset.value, str):
+            raise CompileUnsupported("symbolic trim cutset")
+        c = cutset.value
+        return self._str_transform(
+            target, st, f"trim:{c}", lambda x, _c=c: x.strip(_c)
+        )
+
+    def _builtin_trim_prefix(self, args, st):
+        target, pre = args
+        if not isinstance(pre, SConst) or not isinstance(pre.value, str):
+            raise CompileUnsupported("symbolic trim_prefix arg")
+        c = pre.value
+        return self._str_transform(
+            target,
+            st,
+            f"trimpre:{c}",
+            lambda x, _c=c: x[len(_c):] if x.startswith(_c) else x,
+        )
+
+    def _builtin_sprintf(self, args, st):
+        fmt, arglist = args
+        if isinstance(fmt, SConst) and isinstance(arglist, (SConst, SList)):
+            items = (
+                [v for _, v in arglist.items]
+                if isinstance(arglist, SList)
+                else [SConst(v) for v in arglist.value]
+                if isinstance(arglist.value, list)
+                else None
+            )
+            if items is not None:
+                return [
+                    (
+                        SMsg(
+                            sig=(
+                                "sprintf",
+                                fmt.value,
+                                tuple(_val_sig(v) for v in items),
+                            )
+                        ),
+                        st,
+                    )
+                ]
+        return [(SMsg(), st)]
+
+    def _builtin_concat(self, args, st):
+        if all(isinstance(a, SConst) for a in args):
+            sep, items = args
+            try:
+                return [(SConst(sep.value.join(items.value)), st)]
+            except Exception:
+                return []
+        return [(SMsg(), st)]
+
+    def _builtin_is_number(self, args, st):
+        (v,) = args
+        v = self._leafify(v)
+        if isinstance(v, SConst):
+            return [
+                (
+                    SConst(
+                        isinstance(v.value, (int, float))
+                        and not isinstance(v.value, bool)
+                    ),
+                    st,
+                )
+            ]
+        if isinstance(v, SDerived):
+            return [(SBool(v.defined), st)]
+        if isinstance(v, SScalar):
+            if v.num_override is not None:
+                return [(SBool(v.exists()), st)]
+            return [
+                (
+                    SBool(
+                        e_and(
+                            v.exists(), e_cmp("==", v.kindv(), ELit(K_NUM))
+                        )
+                    ),
+                    st,
+                )
+            ]
+        raise CompileUnsupported("is_number arg")
+
+    def _builtin_is_string(self, args, st):
+        (v,) = args
+        v = self._leafify(v)
+        if isinstance(v, SConst):
+            return [(SConst(isinstance(v.value, str)), st)]
+        if isinstance(v, SDerived):
+            return [(SBool(ELit(False)), st)]
+        if isinstance(v, SScalar):
+            if v.num_override is not None:
+                return [(SBool(ELit(False)), st)]
+            return [
+                (
+                    SBool(
+                        e_and(
+                            v.exists(), e_cmp("==", v.kindv(), ELit(K_STR))
+                        )
+                    ),
+                    st,
+                )
+            ]
+        raise CompileUnsupported("is_string arg")
+
+    def _builtin_is_array(self, args, st):
+        (v,) = args
+        if isinstance(v, SConst):
+            return [(SConst(isinstance(v.value, list)), st)]
+        if isinstance(v, SNode):
+            # an array node has element tokens or the empty-array token
+            if "*" in v.prefix:
+                raise CompileUnsupported("is_array under object iteration")
+            elem_pat = self._pattern(v.prefix + ("#", "**"))
+            exact = self._pattern(v.prefix)
+            axes = _axes_of(v.prefix)
+            from ..flatten.encoder import K_EMPTY_ARR
+
+            empty_arr = e_and(
+                ESelPattern(exact),
+                e_cmp("==", ETokCol("kind"), ELit(K_EMPTY_ARR)),
+            )
+            arrish = e_or(ESelPattern(elem_pat), empty_arr)
+            if not axes:
+                return [(SBool(EReduce(arrish, "any")), st)]
+            if axes in (("g0",), ("g01",)):
+                return [
+                    (SBool(EGroup(arrish, None, axes[0], how="any")), st)
+                ]
+            raise CompileUnsupported("is_array axes")
+        if isinstance(v, (SScalar, SKey, SDerived)):
+            return [(SConst(False), st)] if not isinstance(v, SScalar) else [
+                (SBool(ELit(False)), st)
+            ]
+        raise CompileUnsupported("is_array arg")
+
+    def _builtin_to_number(self, args, st):
+        (v,) = args
+        if isinstance(v, SDerived):
+            # to_number of a number is the number itself
+            return [(v, st)]
+        v = self._leafify(v)
+        if isinstance(v, SConst):
+            try:
+                if isinstance(v.value, bool):
+                    return []
+                return [(SConst(float(v.value)), st)]
+            except (TypeError, ValueError):
+                return []
+        if isinstance(v, SScalar) and v.num_override is None:
+            tname = self.tables.register("to_number", _to_number_host)
+            self.signature.append(("table", tname))
+            ids = v.vid()
+            parsed = EStrTable(tname, ids, default=0.0)
+            parsed_def = EStrTable(tname + "!def", ids, default=False)
+            kind_num = e_cmp("==", v.kindv(), ELit(K_NUM))
+            val = e_where(kind_num, v.num(), parsed)
+            kind_str = e_cmp("==", v.kindv(), ELit(K_STR))
+            dfn = e_and(
+                v.exists(),
+                e_or(kind_num, e_and(kind_str, parsed_def)),
+            )
+            return [(SDerived(num=val, defined=dfn), st)]
+        raise CompileUnsupported("to_number arg")
+
+    def _leafify(self, v: SVal) -> SVal:
+        """Materialize an abstract node as a leaf read where a scalar is
+        consumed (builtin args, comparisons)."""
+        if isinstance(v, SNode):
+            return self._node_leaf(v)
+        return v
+
+    def _string_ids(self, v: SVal) -> Tuple[Expr, Expr]:
+        v = self._leafify(v)
+        if isinstance(v, SScalar):
+            if v.num_override is not None:
+                raise CompileUnsupported("derived used as string")
+            return v.vid(), e_and(
+                v.exists(), e_cmp("==", v.kindv(), ELit(K_STR))
+            )
+        if isinstance(v, SKey):
+            return v.ids(), e_cmp("!=", v.ids(), ELit(-1))
+        raise CompileUnsupported("string operand")
+
+
+def _freeze_sig(sig):
+    """Signatures must be hashable dict keys."""
+    try:
+        hash(sig)
+        return sig
+    except TypeError:
+        return ("unhashable", id(sig))
+
+
+def _val_sig(v):
+    """Render-signature of a symbolic value (see SMsg.sig)."""
+    if isinstance(v, SConst):
+        return ("c", _hashable(v.value))
+    if isinstance(v, SMsg):
+        return v.signature()
+    if isinstance(v, SScalar):
+        if v.pattern_idx >= 0 and v.num_override is None:
+            return ("p", v.pattern_idx, v.tok_space)
+        return ("deriv", id(v))
+    if isinstance(v, SNode):
+        return ("n", v.prefix)
+    if isinstance(v, SKey):
+        return ("k", v.pattern_idx)
+    if isinstance(v, SList):
+        return ("l", tuple(_val_sig(x) for _, x in v.items))
+    return ("opaque", id(v))
+
+
+class _ArrayIndexSentinel:
+    """Binding value of an array-iteration index variable."""
+
+    def __repr__(self):
+        return "<array-index>"
+
+
+_ARRAY_INDEX = _ArrayIndexSentinel()
+
+
+def _hashable(v):
+    if isinstance(v, (list, dict, set)):
+        return json.dumps(v, sort_keys=True, default=str)
+    return v
+
+
+def _is_scalar_const(v) -> bool:
+    return v is None or isinstance(v, (str, int, float, bool))
+
+
+def _norm_num(v):
+    if isinstance(v, float) and not isinstance(v, bool) and v.is_integer():
+        return int(v)
+    return v
+
+
+def _to_number_host(v):
+    """Rego to_number semantics per vocab entry: strings parse, numbers
+    pass, booleans map to 1/0, null to 0."""
+    if v is None:
+        return 0.0, True
+    if isinstance(v, bool):
+        return (1.0 if v else 0.0), True
+    if isinstance(v, (int, float)):
+        return float(v), True
+    try:
+        return float(v), True
+    except (TypeError, ValueError):
+        return 0.0, False
+
+
+def _numeric_oracle(oracle, name: str, value):
+    """Adapter: oracle result must be numeric to live in a float table."""
+    try:
+        res, defined = oracle(name, value)
+    except Exception:
+        return 0.0, False
+    if not defined:
+        return 0.0, False
+    if isinstance(res, bool):
+        return (1.0 if res else 0.0), True
+    if isinstance(res, (int, float)):
+        return float(res), True
+    return 0.0, False

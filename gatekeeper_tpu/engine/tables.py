@@ -1,0 +1,175 @@
+"""Vocab-derived string tables: per-distinct-string predicate/transform caches.
+
+The device never touches strings. Any string computation a template needs —
+regex checks, prefix/suffix tests, quantity canonicalization, arbitrary
+pure string->scalar helper functions (e.g. k8scontainerlimits'
+canonify_cpu) — is evaluated once per distinct vocab entry on the host and
+shipped as a [vocab_size] table the kernel gathers with the token's value
+id. Resource batches share vocab entries heavily, so this amortizes the
+string work the reference's interpreter redoes per object per query.
+
+Tables are registered by name with a callback `fn(raw_string) ->
+(value, defined)`; sync() extends all tables as the vocab grows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..flatten.vocab import Vocab
+
+
+@dataclass
+class _Table:
+    fn: Callable[[str], Tuple[Any, bool]]
+    dtype: Any
+    values: np.ndarray
+    defined: np.ndarray
+
+
+class StrTables:
+    def __init__(self, vocab: Vocab):
+        self.vocab = vocab
+        self._tables: Dict[str, _Table] = {}
+        self.generation = 0
+
+    def register(
+        self,
+        name: str,
+        fn: Callable[[Any], Tuple[Any, bool]],
+        dtype=np.float32,
+    ) -> str:
+        """Idempotent by name. fn receives the decoded scalar VALUE of each
+        vocab entry — a str for "s:" entries, the parsed JSON scalar
+        (number/bool/null) for "j:" entries; path entries are skipped."""
+        if name not in self._tables:
+            self._tables[name] = _Table(
+                fn=fn,
+                dtype=dtype,
+                values=np.zeros((0,), dtype),
+                defined=np.zeros((0,), bool),
+            )
+            self._fill(self._tables[name])
+            self.generation += 1
+        return name
+
+    def _fill(self, t: _Table) -> None:
+        n = len(self.vocab)
+        start = t.values.shape[0]
+        if start >= n:
+            return
+        vals = np.zeros((n,), t.dtype)
+        defined = np.zeros((n,), bool)
+        vals[:start] = t.values
+        defined[:start] = t.defined
+        for i in range(start, n):
+            val = _decode_entry(self.vocab.string(i))
+            if val is _SKIP:
+                continue
+            try:
+                v, d = t.fn(val)
+            except Exception:
+                v, d = 0, False
+            if d:
+                vals[i] = v
+                defined[i] = True
+        t.values = vals
+        t.defined = defined
+
+    def sync(self) -> None:
+        """Extend tables to cover the vocab; loops to a fixed point since
+        id-valued transforms (lower/trim) intern NEW strings during fill."""
+        changed = False
+        while True:
+            n = len(self.vocab)
+            done = all(
+                t.values.shape[0] >= n for t in self._tables.values()
+            )
+            if done and len(self.vocab) == n:
+                break
+            for t in self._tables.values():
+                self._fill(t)
+            changed = True
+            if len(self.vocab) == n:
+                break
+        if changed:
+            self.generation += 1
+
+    def arrays(self) -> Dict[str, np.ndarray]:
+        """name -> values table, name+"!def" -> defined table."""
+        out: Dict[str, np.ndarray] = {}
+        for name, t in self._tables.items():
+            out[name] = t.values
+            out[name + "!def"] = t.defined
+        return out
+
+    # -- common predicate helpers ------------------------------------------
+    # string builtins on non-string values are builtin errors in Rego
+    # (-> undefined), so non-str entries stay defined=False
+
+    def regex(self, pattern: str) -> str:
+        import re as _re
+
+        try:
+            rx = _re.compile(pattern)
+        except _re.error:
+            rx = None
+
+        def fn(s):
+            if rx is None or not isinstance(s, str):
+                return False, False
+            return rx.search(s) is not None, True
+
+        return self.register(f"re:{pattern}", fn, dtype=bool)
+
+    def prefix(self, p: str) -> str:
+        return self.register(
+            f"pre:{p}",
+            lambda s: (s.startswith(p), True) if isinstance(s, str) else (False, False),
+            dtype=bool,
+        )
+
+    def suffix(self, p: str) -> str:
+        return self.register(
+            f"suf:{p}",
+            lambda s: (s.endswith(p), True) if isinstance(s, str) else (False, False),
+            dtype=bool,
+        )
+
+    def contains(self, p: str) -> str:
+        return self.register(
+            f"has:{p}",
+            lambda s: (p in s, True) if isinstance(s, str) else (False, False),
+            dtype=bool,
+        )
+
+
+    def str_transform(self, name: str, fn: Callable[[str], str]) -> str:
+        """id -> id table: interned result of a pure string transform."""
+        vocab = self.vocab
+
+        def table_fn(s):
+            if not isinstance(s, str):
+                return -1, False
+            return vocab.str_id(fn(s)), True
+
+        return self.register(f"xf:{name}", table_fn, dtype=np.int32)
+
+
+_SKIP = object()
+
+
+def _decode_entry(s: str):
+    if s.startswith("s:"):
+        return s[2:]
+    if s.startswith("j:"):
+        import json
+
+        try:
+            return json.loads(s[2:])
+        except ValueError:
+            return _SKIP
+    return _SKIP

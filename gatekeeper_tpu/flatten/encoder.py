@@ -33,6 +33,23 @@ from .vocab import Vocab
 K_NULL, K_BOOL, K_NUM, K_STR, K_EMPTY_OBJ, K_EMPTY_ARR = 0, 1, 2, 3, 4, 5
 
 
+def esc_seg(key: str) -> str:
+    """Escape an object key for use as a path segment: "." would corrupt
+    segment splitting (annotation keys like kubernetes.io/ingress.class)
+    and a literal "#" would collide with the array marker."""
+    if "%" in key or "." in key or key == "#":
+        key = key.replace("%", "%25").replace(".", "%2E")
+        if key == "#":
+            key = "%23"
+    return key
+
+
+def unesc_seg(seg: str) -> str:
+    if "%" not in seg:
+        return seg
+    return seg.replace("%23", "#").replace("%2E", ".").replace("%25", "%")
+
+
 def _bucket(n: int, lo: int = 8) -> int:
     b = lo
     while b < n:
@@ -61,7 +78,7 @@ def flatten_leaves(
                 yield ".".join(path), idx[0], idx[1], K_EMPTY_OBJ, None, 0.0
                 return
             for k in v:
-                path.append(str(k))
+                path.append(esc_seg(str(k)))
                 yield from rec(v[k], path, idx)
                 path.pop()
         elif isinstance(v, list):
@@ -118,7 +135,7 @@ def encode_token_table(
             pid = vocab.intern("p:" + spath)
             if kind == K_STR:
                 vid = vocab.str_id(raw)
-                q = vocab.quantity(vocab.intern(raw))
+                q = vocab.quantity_of_val_id(vid)
                 num = q if q is not None else 0.0
             elif kind in (K_BOOL, K_NUM, K_NULL):
                 vid = vocab.val_id(raw)

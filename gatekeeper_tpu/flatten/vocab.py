@@ -17,6 +17,8 @@ import json
 import re
 from typing import Any, Dict, List, Optional, Tuple
 
+_QUNSET = object()
+
 # k8s resource.Quantity suffixes (apimachinery resource.ParseQuantity)
 _QUANTITY_RE = re.compile(
     r"^([+-]?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
@@ -61,6 +63,7 @@ class Vocab:
         # regex pattern -> {entry_id: bool} lazy caches
         self._regex_cache: Dict[str, Dict[int, bool]] = {}
         self._prefix_cache: Dict[str, Dict[int, bool]] = {}
+        self._vid_quantity: Dict[int, Optional[float]] = {}
 
     def __len__(self) -> int:
         return len(self._strs)
@@ -84,13 +87,31 @@ class Vocab:
     def quantity(self, i: int) -> Optional[float]:
         return self._quantity[i]
 
+    def quantity_of_val_id(self, vid: int) -> Optional[float]:
+        """Quantity parse of a typed value entry ("s:..." strings only),
+        memoized per entry — avoids interning the raw string a second
+        time."""
+        q = self._vid_quantity.get(vid)
+        if q is _QUNSET or q is None and vid not in self._vid_quantity:
+            s = self._strs[vid]
+            q = parse_quantity(s[2:]) if s.startswith("s:") else None
+            self._vid_quantity[vid] = q
+        return q
+
     # -- typed value interning ---------------------------------------------
 
     def val_id(self, v: Any) -> int:
         """Intern an arbitrary JSON scalar with a type tag, so "1" != 1 and
-        "true" != true under id equality."""
+        "true" != true under id equality. Numbers are normalized (1.0 and 1
+        share an id) to match Rego numeric equality."""
         if isinstance(v, str):
             return self.intern("s:" + v)
+        if (
+            isinstance(v, float)
+            and not isinstance(v, bool)
+            and v.is_integer()
+        ):
+            v = int(v)
         return self.intern("j:" + json.dumps(v, sort_keys=True))
 
     def str_id(self, v: str) -> int:

@@ -215,6 +215,60 @@ CONSTRAINTS = [
             "scope": "Namespaced",
         },
     ),
+    constraint(
+        "in-str-values",
+        match={
+            "labelSelector": {
+                "matchExpressions": [
+                    {"key": "app", "operator": "In", "values": "nginx"}
+                ]
+            }
+        },
+    ),
+    constraint(
+        "in-num-values",
+        match={
+            "labelSelector": {
+                "matchExpressions": [
+                    {"key": "app", "operator": "In", "values": 5}
+                ]
+            }
+        },
+    ),
+    constraint(
+        "in-dict-values",
+        match={
+            "labelSelector": {
+                "matchExpressions": [
+                    {"key": "app", "operator": "In", "values": {"k": "nginx"}}
+                ]
+            }
+        },
+    ),
+    constraint(
+        "exists-bad-values",
+        match={
+            "labelSelector": {
+                "matchExpressions": [
+                    {"key": "app", "operator": "Exists", "values": "junk"}
+                ]
+            }
+        },
+    ),
+    constraint(
+        "absent-num-values",
+        match={
+            "labelSelector": {
+                "matchExpressions": [
+                    {"key": "app", "operator": "DoesNotExist", "values": 7}
+                ]
+            }
+        },
+    ),
+    constraint(
+        "label-eq-num",
+        match={"labelSelector": {"matchLabels": {"flag": 1}}},
+    ),
     constraint("scope-null", match={"scope": None}),
     constraint("namespaces-null", match={"namespaces": None}),
     constraint("excluded-null", match={"excludedNamespaces": None}),
@@ -225,6 +279,8 @@ REVIEWS = {
     "pod-prod-nginx": pod_review(labels={"app": "nginx"}),
     "pod-prod-redis": pod_review(labels={"app": "redis"}),
     "pod-prod-nolabels": pod_review(),
+    "pod-bool-label": pod_review(labels={"flag": True}),
+    "pod-num-label": pod_review(labels={"flag": 1}),
     "pod-dev": pod_review(namespace="dev", labels={"app": "nginx"}),
     "pod-uncached-ns": pod_review(namespace="nowhere", labels={"app": "nginx"}),
     "pod-unstable-ns": pod_review(

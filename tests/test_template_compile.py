@@ -1,0 +1,282 @@
+"""Differential tests: compiled template programs vs the interpreter.
+
+For each supported reference-library template, compile it (per constraint
+params) into a token-table program and check the per-resource violation
+COUNT matches interpreter evaluation of the same rewritten module on a
+corpus of synthetic reviews. This is the correctness gate for the
+Rego-subset compiler (gatekeeper_tpu/engine/symbolic.py).
+"""
+
+import os
+
+import numpy as np
+import pytest
+import yaml
+
+from gatekeeper_tpu.engine.patterns import PatternRegistry
+from gatekeeper_tpu.engine.programs import ProgramEvaluator, compile_program
+from gatekeeper_tpu.engine.symbolic import CompilerEnv, CompileUnsupported
+from gatekeeper_tpu.engine.tables import StrTables
+from gatekeeper_tpu.flatten import Vocab, encode_token_table
+from gatekeeper_tpu.rego.interp import Interpreter, Undefined
+from gatekeeper_tpu.rego.parser import parse_module
+from gatekeeper_tpu.rego.rewrite import rewrite_module
+
+REFERENCE = "/root/reference"
+LIB = f"{REFERENCE}/library"
+
+
+def load_template_rego(path: str) -> str:
+    return open(path).read()
+
+
+def pod(containers=None, init_containers=None, labels=None, spec_extra=None,
+        name="p"):
+    spec = {}
+    if containers is not None:
+        spec["containers"] = containers
+    if init_containers is not None:
+        spec["initContainers"] = init_containers
+    if spec_extra:
+        spec.update(spec_extra)
+    meta = {"name": name}
+    if labels is not None:
+        meta["labels"] = labels
+    return {
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": name,
+        "namespace": "default",
+        "object": {"metadata": meta, "spec": spec},
+    }
+
+
+def ctr(name="c", image="nginx", sc=None, resources=None, extra=None):
+    c = {"name": name, "image": image}
+    if sc is not None:
+        c["securityContext"] = sc
+    if resources is not None:
+        c["resources"] = resources
+    if extra:
+        c.update(extra)
+    return c
+
+
+PODS = [
+    pod(containers=[ctr()]),
+    pod(containers=[ctr(sc={"privileged": True})]),
+    pod(containers=[ctr(sc={"privileged": False})]),
+    pod(
+        containers=[ctr("a", sc={"privileged": True}), ctr("b")],
+        init_containers=[ctr("i", sc={"privileged": True})],
+    ),
+    pod(containers=[], labels={"app": "web", "owner": "me"}),
+    pod(containers=[ctr()], labels={"gatekeeper": "ok"}),
+    pod(containers=[ctr()], labels={"gatekeeper": "NOT-ok!!"}),
+    pod(containers=[ctr()], spec_extra={"hostPID": True}),
+    pod(containers=[ctr()], spec_extra={"hostIPC": True, "hostPID": False}),
+    pod(containers=[ctr()], spec_extra={"hostNetwork": True}),
+    pod(
+        containers=[
+            ctr(
+                "caps",
+                sc={
+                    "capabilities": {
+                        "add": ["NET_ADMIN", "SYS_TIME"],
+                        "drop": ["KILL"],
+                    }
+                },
+            ),
+            ctr("nocaps"),
+        ]
+    ),
+    pod(
+        containers=[
+            ctr("x", sc={"capabilities": {"add": ["CHOWN"], "drop": ["ALL"]}})
+        ]
+    ),
+    # container names are unique per pod (a K8s API invariant the
+    # compiled counter's no-msg-dedup approximation relies on)
+    pod(
+        containers=[
+            ctr("good", image="gcr.io/mine/app:1"),
+            ctr("bad", image="docker.io/evil"),
+        ]
+    ),
+    pod(containers=[ctr(resources={"limits": {"cpu": "100m", "memory": "1Gi"}})]),
+    pod(containers=[ctr(resources={"limits": {"cpu": "2", "memory": "4Gi"}})]),
+    pod(containers=[ctr(resources={"limits": {"cpu": "weird", "memory": "x"}})]),
+    pod(containers=[ctr(resources={"limits": {"memory": "512Mi"}})]),
+    pod(containers=[ctr(resources={"limits": {"cpu": 1.5}})]),
+    pod(containers=[ctr(resources={})]),
+    pod(containers=[ctr(resources={"limits": {"cpu": "", "memory": ""}})]),
+    # ingress shapes for httpsonly
+    {
+        "kind": {"group": "extensions", "version": "v1beta1", "kind": "Ingress"},
+        "name": "ing1",
+        "object": {
+            "metadata": {
+                "name": "ing1",
+                "annotations": {"kubernetes.io/ingress.allow-http": "false"},
+            },
+            "spec": {"tls": [{"secretName": "s"}]},
+        },
+    },
+    {
+        "kind": {"group": "networking.k8s.io", "version": "v1", "kind": "Ingress"},
+        "name": "ing2",
+        "object": {"metadata": {"name": "ing2"}, "spec": {"rules": []}},
+    },
+    {
+        "kind": {"group": "extensions", "version": "v1beta1", "kind": "Ingress"},
+        "name": "ing3",
+        "object": {
+            "metadata": {"name": "ing3"},
+            "spec": {"tls": []},
+        },
+    },
+    # degenerate shapes
+    pod(containers=None),
+    {"kind": {"group": "", "version": "v1", "kind": "Pod"}, "name": "empty",
+     "object": {}},
+]
+
+
+def make_env():
+    vocab = Vocab()
+    patterns = PatternRegistry(vocab)
+    tables = StrTables(vocab)
+    return vocab, patterns, tables
+
+
+def compile_and_count(src, params, reviews, oracle_interp=None, pkg=None):
+    vocab, patterns, tables = make_env()
+    mod = parse_module(src)
+    rewrite_module(mod)
+
+    def oracle_fn(fn_name, value):
+        probe = (
+            f"package __probe\nout := data.{pkg}.{fn_name}(input.arg)\n"
+        )
+        oracle_interp.add_module("__probe", probe)
+        ctx = oracle_interp.make_context(
+            {"arg": value, "parameters": params}, {}
+        )
+        v = oracle_interp.eval_rule_extent(["__probe"], "out", ctx)
+        if v is Undefined:
+            return None, False
+        from gatekeeper_tpu.rego.values import thaw
+
+        return thaw(v), True
+
+    env = CompilerEnv(
+        vocab,
+        patterns,
+        tables,
+        oracle_fn=oracle_fn if oracle_interp else None,
+        oracle_ns=pkg or "t",
+    )
+    prog = compile_program(env, [mod], params)
+    table = encode_token_table(reviews, vocab)
+    patterns.sync()
+    tables.sync()
+    tok = {
+        "spath": table.spath,
+        "idx0": table.idx0,
+        "idx1": table.idx1,
+        "kind": table.kind,
+        "vid": table.vid,
+        "vnum": table.vnum,
+    }
+    ev = ProgramEvaluator(patterns, tables, use_jax=False)
+    return ev.eval_np(prog, tok, g=8)
+
+
+def oracle_count(src, params, reviews):
+    interp = Interpreter()
+    interp.add_module("t", src)
+    pkg = interp.modules["t"].package
+    out = []
+    for r in reviews:
+        vios = interp.query_violations(
+            list(pkg), {"review": r, "parameters": params}, {}
+        )
+        out.append(len(vios))
+    return np.array(out), interp, ".".join(pkg)
+
+
+def assert_template_agrees(src_path, params, reviews=PODS):
+    src = load_template_rego(src_path)
+    want, interp, pkg = oracle_count(src, params, reviews)
+    got = compile_and_count(src, params, reviews, oracle_interp=interp, pkg=pkg)
+    if not np.array_equal(got, want):
+        bad = [
+            (i, int(got[i]), int(want[i]))
+            for i in range(len(want))
+            if got[i] != want[i]
+        ]
+        raise AssertionError(
+            f"{os.path.basename(os.path.dirname(src_path))}: "
+            f"params={params} mismatches (idx, compiled, oracle): {bad}"
+        )
+
+
+def test_privileged_containers():
+    assert_template_agrees(
+        f"{LIB}/pod-security-policy/privileged-containers/src.rego", {}
+    )
+
+
+def test_host_namespaces():
+    assert_template_agrees(
+        f"{LIB}/pod-security-policy/host-namespaces/src.rego", {}
+    )
+
+
+def test_host_network_ports():
+    assert_template_agrees(
+        f"{LIB}/pod-security-policy/host-network-ports/src.rego",
+        {"hostNetwork": False},
+    )
+
+
+def test_required_labels():
+    assert_template_agrees(
+        f"{LIB}/general/requiredlabels/src.rego",
+        {"labels": [{"key": "gatekeeper", "allowedRegex": "^[a-z]+$"}]},
+    )
+    assert_template_agrees(
+        f"{LIB}/general/requiredlabels/src.rego",
+        {"labels": [{"key": "app"}, {"key": "owner"}]},
+    )
+
+
+def test_capabilities():
+    assert_template_agrees(
+        f"{LIB}/pod-security-policy/capabilities/src.rego",
+        {
+            "allowedCapabilities": ["CHOWN"],
+            "requiredDropCapabilities": ["ALL"],
+        },
+    )
+    assert_template_agrees(
+        f"{LIB}/pod-security-policy/capabilities/src.rego",
+        {"allowedCapabilities": ["*"], "requiredDropCapabilities": []},
+    )
+
+
+def test_allowed_repos():
+    assert_template_agrees(
+        f"{LIB}/general/allowedrepos/src.rego",
+        {"repos": ["gcr.io/mine"]},
+    )
+
+
+def test_https_only():
+    assert_template_agrees(f"{LIB}/general/httpsonly/src.rego", {})
+
+
+def test_container_limits():
+    assert_template_agrees(
+        f"{LIB}/general/containerlimits/src.rego",
+        {"cpu": "1", "memory": "2Gi"},
+    )
