@@ -27,6 +27,7 @@ from .errors import (  # noqa: F401
 )
 from .datastore import DataStore, PathConflictError  # noqa: F401
 from .driver import Driver, RegoDriver  # noqa: F401
+from .tpudriver import TpuDriver  # noqa: F401
 from .target import (  # noqa: F401
     AdmissionRequest,
     AugmentedReview,

@@ -20,9 +20,32 @@ class PathConflictError(Exception):
     """Write path traverses an existing non-object value."""
 
 
+_HEX = set("0123456789abcdefABCDEF")
+
+
+def _path_unescape(seg: str) -> str:
+    """Go url.PathUnescape: %XX decoded ("+" untouched); any malformed
+    escape errors, in which case ParsePathEscaped keeps the segment as-is
+    (opa/storage/path.go:35-46)."""
+    if "%" not in seg:
+        return seg
+    i = seg.find("%")
+    while i != -1:
+        if len(seg) - i < 3 or seg[i + 1] not in _HEX or seg[i + 2] not in _HEX:
+            return seg  # malformed escape: keep original
+        i = seg.find("%", i + 3)
+    from urllib.parse import unquote
+
+    return unquote(seg)
+
+
 def parse_path(path: PathLike) -> List[str]:
+    """storage.ParsePathEscaped (local.go:233-239): split on "/", then
+    URL-unescape each segment — data keys hold the unescaped form (e.g.
+    groupVersion "extensions/v1beta1"), the escaping exists only in the
+    path-string transport."""
     if isinstance(path, str):
-        return [seg for seg in path.split("/") if seg != ""]
+        return [_path_unescape(seg) for seg in path.split("/") if seg != ""]
     return list(path)
 
 

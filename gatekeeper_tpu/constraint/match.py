@@ -468,22 +468,28 @@ def autoreject(
 # -- audit cross-join -------------------------------------------------------
 
 
-def make_group_version(api_version: str) -> Tuple[str, str]:
-    """make_group_version (:74-83). Keys are url.PathEscape()d groupVersions
-    (pkg/target/target.go:73), so non-core groups arrive as e.g. "apps%2Fv1"
-    and deliberately fail the "/" split, yielding group "" — a reference
-    quirk preserved for audit-from-cache parity."""
+def make_group_version(api_version: str) -> Optional[Tuple[str, str]]:
+    """make_group_version (:74-83). Data keys hold *unescaped*
+    groupVersions (storage.ParsePathEscaped unescapes what
+    target.go:73's url.PathEscape encoded), so "apps/v1" splits into
+    ("apps", "v1"). The Rego `[group, version] := split(...)` destructure
+    is undefined for 2+ slashes — mirrored as None (object skipped)."""
     if "/" in api_version:
-        group, version = api_version.split("/", 1)
-        return group, version
+        parts = api_version.split("/")
+        if len(parts) != 2:
+            return None
+        return parts[0], parts[1]
     return "", api_version
 
 
 def make_review(
     obj: Any, api_version: str, kind: str, name: str, namespace: Optional[str] = None
-) -> Dict[str, Any]:
+) -> Optional[Dict[str, Any]]:
     """make_review (:61-68) + add_field namespace for namespaced objects."""
-    group, version = make_group_version(api_version)
+    gv = make_group_version(api_version)
+    if gv is None:
+        return None
+    group, version = gv
     review: Dict[str, Any] = {
         "kind": {"group": group, "version": version, "kind": kind},
         "name": name,
@@ -511,7 +517,9 @@ def iter_cached_reviews(external: Any):
                     if not isinstance(by_name, dict):
                         continue
                     for name, obj in sorted(by_name.items()):
-                        yield make_review(obj, gv, kind, name, namespace=ns_name)
+                        r = make_review(obj, gv, kind, name, namespace=ns_name)
+                        if r is not None:
+                            yield r
     cluster = external.get("cluster")
     if isinstance(cluster, dict):
         for gv, by_kind in sorted(cluster.items()):
@@ -521,4 +529,6 @@ def iter_cached_reviews(external: Any):
                 if not isinstance(by_name, dict):
                     continue
                 for name, obj in sorted(by_name.items()):
-                    yield make_review(obj, gv, kind, name)
+                    r = make_review(obj, gv, kind, name)
+                    if r is not None:
+                        yield r

@@ -85,9 +85,14 @@ violation[{"msg": msg}] {
 """
 
 
-@pytest.fixture
-def client():
-    backend = Backend(RegoDriver())
+@pytest.fixture(params=["rego", "tpu"])
+def client(request):
+    """Driver-parameterized battery (probe_client.go:15): every test runs
+    against both the interpreter driver and the compiled TPU driver."""
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    driver = RegoDriver() if request.param == "rego" else TpuDriver()
+    backend = Backend(driver)
     return backend.new_client(K8sValidationTarget())
 
 
