@@ -1,0 +1,208 @@
+"""Benchmark: full-cluster audit throughput, TPU driver vs CPU baseline.
+
+Workload modeled on BASELINE.md config #5 (cluster-scale audit) with the
+template mix of configs #2/#3: N synthetic pods x C constraints drawn
+from the compiled library templates (PSP + general), ~1% violation rate.
+The CPU baseline is the interpreter driver (RegoDriver — the counterpart
+of the reference's drivers/local) measured on a subsample and scaled to
+constraint-evals/sec; the reference harness it mirrors is
+pkg/webhook/policy_benchmark_test.go:233-329 (PSP templates, constraint
+loads up to 2000).
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": "audit_constraint_evals_per_sec_per_chip",
+   "value": ..., "unit": "evals/s", "vs_baseline": ...}
+plus human-readable detail on stderr.
+
+Usage: python bench.py [N_RESOURCES] [N_CONSTRAINTS]   (default 100000 500)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+TARGET = "admission.k8s.gatekeeper.sh"
+LIB = "/root/reference/library"
+
+
+def _load_template(path):
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def _constraint(kind, name, params=None):
+    spec = {
+        "match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+    }
+    if params is not None:
+        spec["parameters"] = params
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+# (template dir, kind, params variants) — the compiled subset; params
+# cycle so same-template constraints exercise distinct const tensors
+TEMPLATE_MIX = [
+    (f"{LIB}/pod-security-policy/privileged-containers",
+     "K8sPSPPrivilegedContainer", [None]),
+    (f"{LIB}/pod-security-policy/host-namespaces",
+     "K8sPSPHostNamespace", [None]),
+    (f"{LIB}/pod-security-policy/capabilities", "K8sPSPCapabilities", [
+        # empty requiredDrop: only pods that *add* forbidden caps violate
+        {"allowedCapabilities": ["CHOWN"], "requiredDropCapabilities": []},
+        {"allowedCapabilities": ["CHOWN", "KILL"],
+         "requiredDropCapabilities": []},
+    ]),
+    (f"{LIB}/general/allowedrepos", "K8sAllowedRepos", [
+        {"repos": ["nginx", "gcr.io/prod"]},
+        {"repos": ["nginx", "gcr.io/prod", "quay.io/infra"]},
+    ]),
+    (f"{LIB}/general/requiredlabels", "K8sRequiredLabels", [
+        {"labels": [{"key": "app"}]},
+        {"labels": [{"key": "app"}, {"key": "owner"}]},
+    ]),
+    (f"{LIB}/general/containerlimits", "K8sContainerLimits", [
+        {"cpu": "4", "memory": "8Gi"},
+        {"cpu": "8", "memory": "16Gi"},
+    ]),
+]
+
+
+def make_pod(i):
+    # sparse violations (steady-state clusters are mostly compliant; each
+    # bad pod violates every matching constraint of that template, so the
+    # violating-pair count is ~bad_pods x constraints_per_template)
+    labels = {"app": f"svc{i % 17}", "owner": f"team{i % 5}"}
+    if i % 4999 == 0:
+        labels.pop("owner")
+    image = "nginx" if i % 5003 else "docker.io/evil"
+    sc = {}
+    if i % 5009 == 0:
+        sc = {"securityContext": {"privileged": True}}
+    c = {
+        "name": "main",
+        "image": image,
+        "resources": {"limits": {"cpu": "1", "memory": "2Gi"}},
+        **sc,
+    }
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"p{i}",
+            "namespace": f"ns{i % 23}",
+            "labels": labels,
+        },
+        "spec": {"containers": [c]},
+    }
+
+
+def build_client(driver, n_resources, n_constraints):
+    from gatekeeper_tpu.constraint import Backend, K8sValidationTarget
+
+    client = Backend(driver).new_client(K8sValidationTarget())
+    for tdir, kind, _ in TEMPLATE_MIX:
+        client.add_template(_load_template(f"{tdir}/template.yaml"))
+    i = 0
+    while i < n_constraints:
+        tdir, kind, variants = TEMPLATE_MIX[i % len(TEMPLATE_MIX)]
+        params = variants[(i // len(TEMPLATE_MIX)) % len(variants)]
+        client.add_constraint(_constraint(kind, f"c{i}", params))
+        i += 1
+    for j in range(n_resources):
+        client.add_data(make_pod(j))
+    return client
+
+
+def main():
+    n_resources = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    n_constraints = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+    err = sys.stderr
+
+    import jax
+    from gatekeeper_tpu.constraint import RegoDriver
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    print(f"devices: {jax.devices()}", file=err)
+
+    # -- CPU baseline (subsample, interpreter driver) -----------------------
+    cpu_n, cpu_c = min(100, n_resources), min(25, n_constraints)
+    cpu_client = build_client(RegoDriver(), cpu_n, cpu_c)
+    t0 = time.perf_counter()
+    cpu_results = cpu_client.audit().by_target[TARGET].results
+    cpu_t = time.perf_counter() - t0
+    cpu_evals = cpu_n * cpu_c
+    cpu_rate = cpu_evals / cpu_t
+    print(
+        f"cpu baseline: {cpu_n}x{cpu_c} = {cpu_evals} evals in {cpu_t:.2f}s "
+        f"-> {cpu_rate:,.0f} evals/s ({len(cpu_results)} violations)",
+        file=err,
+    )
+
+    # -- TPU driver ---------------------------------------------------------
+    drv = TpuDriver()
+    t0 = time.perf_counter()
+    client = build_client(drv, n_resources, n_constraints)
+    print(f"ingest: {time.perf_counter()-t0:.1f}s", file=err)
+
+    t0 = time.perf_counter()
+    results = client.audit().by_target[TARGET].results
+    warm_t = time.perf_counter() - t0
+    print(
+        f"first sweep (encode+compile): {warm_t:.1f}s, "
+        f"{len(results)} violations, stats={drv.stats}",
+        file=err,
+    )
+
+    sweep_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        results = client.audit().by_target[TARGET].results
+        sweep_times.append(time.perf_counter() - t0)
+    best = min(sweep_times)
+    evals = n_resources * n_constraints
+    rate = evals / best
+    print(
+        f"steady-state sweeps: {['%.3fs' % t for t in sweep_times]} "
+        f"-> best {best:.3f}s = {rate:,.0f} evals/s "
+        f"({len(results)} violations)",
+        file=err,
+    )
+    print(
+        f"speedup vs cpu interpreter baseline: {rate / cpu_rate:.1f}x",
+        file=err,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "audit_constraint_evals_per_sec_per_chip",
+                "value": round(rate, 1),
+                "unit": "evals/s",
+                "vs_baseline": round(rate / cpu_rate, 2),
+                "detail": {
+                    "n_resources": n_resources,
+                    "n_constraints": n_constraints,
+                    "sweep_seconds": round(best, 4),
+                    "violations": len(results),
+                    "cpu_evals_per_sec": round(cpu_rate, 1),
+                    "north_star": "100k x 500 < 2s",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,211 @@
+"""Audit sweep manager.
+
+Mirrors the behavioral contract of pkg/audit/manager.go:
+  * sweep cadence `audit_interval` (default 60s, manager.go:42,344-358);
+  * per-constraint violation cap `constraint_violations_limit`
+    (default 20, manager.go:43,49,499-506);
+  * violation messages truncated to `msg_size` bytes with a "..."
+    suffix (manager.go:503,560-568);
+  * per-constraint status records carrying audit timestamp, total
+    violation count, and the capped violation details
+    (manager.go:493-558), plus per-enforcement-action totals
+    (manager.go:400-446).
+
+The reference writes statuses to each Constraint CR's
+`status.violations` via the K8s API with retry/backoff
+(manager.go:581-639); here publication goes through a pluggable
+`StatusSink` (in-memory by default; the control-plane layer provides a
+cluster-backed one).
+
+The data path difference is the point: instead of one interpreted query
+per object (manager.go:318), one `Client.audit()` call sweeps the whole
+cached state through the TPU driver's fused kernel.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+DEFAULT_AUDIT_INTERVAL = 60.0
+DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT = 20
+DEFAULT_MSG_SIZE = 256
+
+
+def truncate_message(msg: str, size: int = DEFAULT_MSG_SIZE) -> str:
+    """truncateString (manager.go:560-568): overlong messages keep the
+    first size-3 chars plus '...'."""
+    if len(msg) <= size:
+        return msg
+    if size > 3:
+        size -= 3
+    return msg[:size] + "..."
+
+
+@dataclass
+class Violation:
+    """One entry of a constraint's status.violations list
+    (apis/status/v1beta1 shape, populated by manager.go:509-520)."""
+
+    message: str
+    enforcement_action: str
+    kind: str
+    name: str
+    namespace: str
+
+
+@dataclass
+class ConstraintStatus:
+    """Aggregated per-constraint audit status."""
+
+    constraint_kind: str
+    constraint_name: str
+    audit_timestamp: str
+    total_violations: int
+    violations: List[Violation] = field(default_factory=list)
+
+
+@dataclass
+class AuditReport:
+    """One sweep's outcome."""
+
+    timestamp: str
+    duration_seconds: float
+    total_violations: int
+    by_enforcement_action: Dict[str, int]
+    statuses: Dict[str, ConstraintStatus]  # key: "<Kind>/<name>"
+
+
+class StatusSink:
+    """Publication boundary for constraint statuses (the reference's
+    equivalent is the status.violations API write loop)."""
+
+    def publish(self, report: AuditReport) -> None:
+        raise NotImplementedError
+
+
+class InMemorySink(StatusSink):
+    def __init__(self):
+        self.reports: List[AuditReport] = []
+
+    def publish(self, report: AuditReport) -> None:
+        self.reports.append(report)
+
+    @property
+    def latest(self) -> Optional[AuditReport]:
+        return self.reports[-1] if self.reports else None
+
+
+class AuditManager:
+    """Periodic audit sweeps over a constraint-framework Client."""
+
+    def __init__(
+        self,
+        client,
+        target: str,
+        sink: Optional[StatusSink] = None,
+        audit_interval: float = DEFAULT_AUDIT_INTERVAL,
+        constraint_violations_limit: int = DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT,
+        msg_size: int = DEFAULT_MSG_SIZE,
+        now: Callable[[], float] = time.time,
+    ):
+        self.client = client
+        self.target = target
+        self.sink = sink if sink is not None else InMemorySink()
+        self.audit_interval = audit_interval
+        self.violations_limit = constraint_violations_limit
+        self.msg_size = msg_size
+        self._now = now
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_run_seconds: Optional[float] = None
+        self.audit_duration_seconds: Optional[float] = None
+        self.last_error: Optional[BaseException] = None
+        self.error_count = 0
+
+    # -- one sweep -----------------------------------------------------------
+
+    def audit(self) -> AuditReport:
+        """One full sweep: Client.audit over the cached state, then the
+        reference's aggregation contract (cap, truncate, publish)."""
+        t0 = self._now()
+        timestamp = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(int(t0))
+        )
+        resp = self.client.audit().by_target.get(self.target)
+        results = resp.results if resp is not None else []
+
+        statuses: Dict[str, ConstraintStatus] = {}
+        totals_by_ea: Dict[str, int] = {}
+        for r in results:
+            ckind = (r.constraint or {}).get("kind", "?")
+            cname = ((r.constraint or {}).get("metadata") or {}).get(
+                "name", "?"
+            )
+            key = f"{ckind}/{cname}"
+            st = statuses.get(key)
+            if st is None:
+                st = ConstraintStatus(
+                    constraint_kind=ckind,
+                    constraint_name=cname,
+                    audit_timestamp=timestamp,
+                    total_violations=0,
+                )
+                statuses[key] = st
+            st.total_violations += 1
+            ea = r.enforcement_action or "deny"
+            totals_by_ea[ea] = totals_by_ea.get(ea, 0) + 1
+            # cap (manager.go:499-506): count everything, detail the
+            # first `violations_limit`
+            if len(st.violations) < self.violations_limit:
+                res = r.resource if isinstance(r.resource, dict) else {}
+                meta = res.get("metadata") or {}
+                st.violations.append(
+                    Violation(
+                        message=truncate_message(r.msg or "", self.msg_size),
+                        enforcement_action=ea,
+                        kind=res.get("kind", ""),
+                        name=meta.get("name", ""),
+                        namespace=meta.get("namespace", ""),
+                    )
+                )
+
+        duration = self._now() - t0
+        report = AuditReport(
+            timestamp=timestamp,
+            duration_seconds=duration,
+            total_violations=len(results),
+            by_enforcement_action=totals_by_ea,
+            statuses=statuses,
+        )
+        self.sink.publish(report)
+        self.last_run_seconds = t0
+        self.audit_duration_seconds = duration
+        return report
+
+    # -- sweep loop (auditManagerLoop, manager.go:344-358) -------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.audit()
+                self.last_error = None
+            except Exception as e:  # sweep failures don't kill the loop
+                self.last_error = e
+                self.error_count += 1
+            self._stop.wait(self.audit_interval)

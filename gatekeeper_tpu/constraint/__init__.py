@@ -27,7 +27,6 @@ from .errors import (  # noqa: F401
 )
 from .datastore import DataStore, PathConflictError  # noqa: F401
 from .driver import Driver, RegoDriver  # noqa: F401
-from .tpudriver import TpuDriver  # noqa: F401
 from .target import (  # noqa: F401
     AdmissionRequest,
     AugmentedReview,
@@ -37,3 +36,13 @@ from .target import (  # noqa: F401
 )
 from .templates import ConstraintTemplate, CRD  # noqa: F401
 from .client import Client, Backend  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: tpudriver pulls in the engine package, which itself imports
+    # constraint.match — a cycle if resolved during this __init__
+    if name == "TpuDriver":
+        from .tpudriver import TpuDriver
+
+        return TpuDriver
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -130,6 +130,22 @@ class Client:
             if cached is not None and _template_equal(cached.template, ct):
                 resp.handled[target] = True
                 return resp
+            if cached is not None and cached.targets != [target]:
+                # re-targeted template update: unmount the old target's
+                # modules and constraint data (or they stay evaluatable),
+                # then re-home the cached constraints under the new target
+                for old in cached.targets:
+                    self._driver.delete_modules(
+                        f'templates["{old}"]["{cached.crd.kind}"]'
+                    )
+                    self._driver.delete_data(
+                        f"/constraints/{old}/cluster/{CONSTRAINT_GROUP}/"
+                        f"{cached.crd.kind}"
+                    )
+                for subpath, c in self._constraints.get(
+                    (CONSTRAINT_GROUP, cached.crd.kind), {}
+                ).items():
+                    self._driver.put_data(f"/constraints/{target}/{subpath}", c)
             self._driver.put_modules(prefix, modules)
             self._templates[ct.name] = _TemplateEntry(
                 template=ct, crd=crd, targets=[target]

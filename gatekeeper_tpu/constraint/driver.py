@@ -90,6 +90,10 @@ class RegoDriver(Driver):
         # equivalent of the reference driver's modulesMux RWMutex
         # (drivers/local/local.go:63)
         self._mutex = threading.RLock()
+        # frozen-inventory cache: freezing the external tree is O(corpus)
+        # and would otherwise happen once per evaluated violation
+        self._data_version = 0
+        self._frozen_inv: Dict[str, Tuple[int, Any]] = {}
 
     def init(self) -> None:
         """No hook-library installation needed — hooks are native."""
@@ -137,10 +141,13 @@ class RegoDriver(Driver):
     def put_data(self, path: str, data: Any) -> None:
         with self._mutex:
             self.storage.put(path, data)
+            self._data_version += 1
 
     def delete_data(self, path: str) -> bool:
         with self._mutex:
-            return self.storage.delete(path)
+            existed = self.storage.delete(path)
+            self._data_version += 1
+            return existed
 
     # -- query ---------------------------------------------------------------
 
@@ -194,9 +201,18 @@ class RegoDriver(Driver):
         return cache if isinstance(cache, dict) else {}
 
     def _inventory(self, target: str) -> Any:
-        """inventory rule (client/regolib/src.go:66-71)."""
+        """inventory rule (client/regolib/src.go:66-71), pre-frozen and
+        cached per data version (interp.make_context re-freezes in O(1)
+        via the values.freeze Obj fast path)."""
+        cached = self._frozen_inv.get(target)
+        if cached is not None and cached[0] == self._data_version:
+            return cached[1]
+        from ..rego.values import freeze
+
         inv = self.storage.get(["external", target], None)
-        return inv if inv is not None else {}
+        frozen = freeze(inv if inv is not None else {})
+        self._frozen_inv[target] = (self._data_version, frozen)
+        return frozen
 
     def _violation(
         self, target: str, input: Dict[str, Any], trace: Optional[List[str]]

@@ -345,7 +345,8 @@ def matches_namespaces(match: Any, review: Any) -> bool:
     if ns is _MISSING:
         return False
     nss = match["namespaces"]
-    return isinstance(nss, list) and ns in nss
+    # Rego set membership, not Python `in` (True != 1 under Rego equality)
+    return isinstance(nss, list) and any(rego_scalar_eq(ns, n) for n in nss)
 
 
 def does_not_match_excludednamespaces(match: Any, review: Any) -> bool:
@@ -362,7 +363,7 @@ def does_not_match_excludednamespaces(match: Any, review: Any) -> bool:
         # `{n | n = match.excludedNamespaces[_]}` over a non-array is the
         # empty set, so ns is trivially not excluded
         return True
-    return ns not in nss
+    return not any(rego_scalar_eq(ns, n) for n in nss)
 
 
 def matches_nsselector(

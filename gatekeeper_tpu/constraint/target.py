@@ -105,7 +105,9 @@ class K8sValidationTarget:
         if kind == "":
             raise ValueError(f"resource {name} has no kind")
         gv = f"{group}/{version}" if group else version
-        gv = urllib.parse.quote(gv, safe="$&+,:;=?@!*'()~")  # url.PathEscape
+        # Go url.PathEscape (encodePathSegment): '$&+:=@' and unreserved
+        # stay raw; '/;,?' and the RFC sub-delims !*'() are escaped
+        gv = urllib.parse.quote(gv, safe="$&+:=@")
         namespace = _meta(obj, "namespace")
         if namespace == "":
             return True, f"cluster/{gv}/{kind}/{name}", obj

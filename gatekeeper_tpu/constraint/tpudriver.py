@@ -44,7 +44,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..engine.matchkernel import match_matrix, matchspec_to_device
+from ..engine.matchkernel import matchspec_to_np
 from ..engine.matchspec import compile_match_specs
 from ..engine.patterns import PatternRegistry
 from ..engine.programs import Program, ProgramEvaluator, compile_program
@@ -100,7 +100,7 @@ class _ConstraintSet:
 
     constraint_gen: int
     constraints: List[Dict[str, Any]]
-    ms_dev: Dict[str, Any]
+    ms: Dict[str, np.ndarray]
     programs: List[Optional[Program]]  # index-aligned; None => fallback
     prog_rows: List[int]  # constraint index -> row in compiled stack (-1)
 
@@ -109,7 +109,7 @@ class TpuDriver(RegoDriver):
     """Compiled-engine driver: device-batched audit/review, interpreter
     fallback for the uncompilable remainder."""
 
-    def __init__(self, use_jax: bool = True):
+    def __init__(self, use_jax: bool = True, mesh=None):
         super().__init__()
         self.vocab = Vocab()
         self.patterns = PatternRegistry(self.vocab)
@@ -118,6 +118,14 @@ class TpuDriver(RegoDriver):
         self.evaluator = ProgramEvaluator(
             self.patterns, self.tables, use_jax=use_jax
         )
+        if use_jax:
+            from ..parallel.sharding import FusedAuditKernel
+
+            self.kernel = FusedAuditKernel(
+                self.patterns, self.tables, mesh=mesh
+            )
+        else:
+            self.kernel = None
         # (target, kind) -> rewritten template modules
         self._kind_modules: Dict[Tuple[str, str], List[A.Module]] = {}
         # (target, kind, params_key) -> Program | None (None = fallback)
@@ -256,7 +264,7 @@ class TpuDriver(RegoDriver):
         cs = _ConstraintSet(
             constraint_gen=self._constraint_gen,
             constraints=constraints,
-            ms_dev=matchspec_to_device(ms) if self.use_jax else ms,
+            ms=matchspec_to_np(ms),
             programs=programs,
             prog_rows=prog_rows,
         )
@@ -286,6 +294,8 @@ class TpuDriver(RegoDriver):
         )
         g = _bucket(max(max_idx + 1, 1), lo=8)
         row_fallback = np.asarray(table.overflow).copy()
+        if fb.label_overflow is not None:
+            row_fallback |= fb.label_overflow
         if g > G_CAP:
             g = G_CAP
             over = (table.idx0 >= G_CAP).any(axis=1) | (
@@ -327,7 +337,6 @@ class TpuDriver(RegoDriver):
         n = len(corpus.reviews)
         if not self.use_jax:
             return self._match_and_counts_np(cs, corpus, compiled, n, ns_cache)
-        import jax.numpy as jnp
 
         match_out = np.zeros((len(cs.constraints), n), bool)
         counts_out = (
@@ -338,17 +347,17 @@ class TpuDriver(RegoDriver):
             end = min(start + chunk, n)
             pad = chunk - (end - start)
             fb_c = {
-                k: jnp.asarray(_pad_rows(v[start:end], pad))
+                k: _pad_rows(v[start:end], pad)
                 for k, v in corpus.fb_dev.items()
             }
             tok_c = {
                 k: _pad_rows(v[start:end], pad, fill=0 if k == "vnum" else -1)
                 for k, v in corpus.tok.items()
             }
-            m = np.asarray(match_matrix(cs.ms_dev, fb_c))
+            # ONE fused dispatch per chunk: match kernel + all programs
+            m, c, _ = self.kernel.run(cs.programs, cs.ms, fb_c, tok_c, corpus.g)
             match_out[:, start:end] = m[:, : end - start]
             if compiled:
-                c = self.evaluator.eval_jax(compiled, tok_c, g=corpus.g)
                 counts_out[:, start:end] = c[:, : end - start]
         return match_out, counts_out
 
@@ -439,41 +448,43 @@ class TpuDriver(RegoDriver):
             self.tables.sync()
             match, counts = self._match_and_counts(cs, corpus, ns_cache)
 
-            n_compiled_pairs = 0
-            n_interp_pairs = 0
+            # vectorized pair selection: only the sparse set of pairs that
+            # need interpreter work is visited in Python — violating
+            # compiled pairs (count > 0) plus every matched fallback pair
+            c_count = len(cs.constraints)
+            n_count = len(reviews)
+            prog_rows_arr = np.asarray(cs.prog_rows, np.int64)  # [C]
+            compiled_c = prog_rows_arr >= 0  # [C]
+            row_fb = np.asarray(corpus.row_fallback[:n_count], bool)  # [N]
+            viol = np.zeros((c_count, n_count), bool)
+            if counts is not None and compiled_c.any():
+                viol[compiled_c] = counts[prog_rows_arr[compiled_c]] > 0
+            fallback_pair = ~compiled_c[:, None] | row_fb[None, :]
+            need = match & (viol | fallback_pair)
+            # review-major emit order (matches RegoDriver._audit's loop)
+            pairs = np.argwhere(need.T)
             results: List[Result] = []
-            for n, review in enumerate(reviews):
-                row_fb = bool(corpus.row_fallback[n])
-                for ci, constraint in enumerate(cs.constraints):
-                    if not match[ci, n]:
-                        continue
-                    prog_row = cs.prog_rows[ci]
-                    if prog_row < 0 or row_fb:
-                        n_interp_pairs += 1
-                        results.extend(
-                            self._eval_template(
-                                target, constraint, review, inventory, trace
-                            )
-                        )
-                        continue
-                    n_compiled_pairs += 1
-                    if counts is not None and counts[prog_row, n] > 0:
-                        results.extend(
-                            self._eval_template(
-                                target, constraint, review, inventory, trace
-                            )
-                        )
+            for n_i, c_i in pairs:
+                results.extend(
+                    self._eval_template(
+                        target,
+                        cs.constraints[c_i],
+                        reviews[n_i],
+                        inventory,
+                        trace,
+                    )
+                )
             self.stats = {
-                "compiled_pairs": n_compiled_pairs,
-                "interp_pairs": n_interp_pairs,
-                "n_reviews": len(reviews),
-                "n_constraints": len(cs.constraints),
+                "compiled_pairs": int((match & ~fallback_pair).sum()),
+                "interp_pairs": int((match & fallback_pair).sum()),
+                "n_reviews": n_count,
+                "n_constraints": c_count,
                 "n_results": len(results),
             }
             if trace is not None:
                 trace.append(
-                    f"tpu dispatch: {n_compiled_pairs} compiled pairs, "
-                    f"{n_interp_pairs} interpreter pairs"
+                    f"tpu dispatch: {self.stats['compiled_pairs']} compiled "
+                    f"pairs, {self.stats['interp_pairs']} interpreter pairs"
                 )
             return results
 

@@ -181,25 +181,33 @@ def match_matrix(ms: dict, fb: dict) -> jnp.ndarray:
     return kind_ok & ns_ok & excl_ok & scope_ok & nssel_ok & label_ok
 
 
-def matchspec_to_device(ms: MatchSpecSet) -> dict:
+def matchspec_to_np(ms: MatchSpecSet) -> dict:
+    """MatchSpecSet -> plain dict of numpy arrays (the kernel's input
+    keys); callers shard/pad/ship to device as they see fit."""
+    import numpy as np
+
     return {
-        "kind_rows": jnp.asarray(ms.kind_rows),
-        "ns_has": jnp.asarray(ms.ns_has),
-        "ns_ids": jnp.asarray(ms.ns_ids),
-        "excl_has": jnp.asarray(ms.excl_has),
-        "excl_ids": jnp.asarray(ms.excl_ids),
-        "scope": jnp.asarray(ms.scope),
-        "lab_invalid": jnp.asarray(ms.lab_invalid),
-        "lab_ml": jnp.asarray(ms.lab_ml),
-        "lab_expr": jnp.asarray(ms.lab_expr),
-        "lab_expr_vals": jnp.asarray(ms.lab_expr_vals),
-        "nssel_has": jnp.asarray(ms.nssel_has),
-        "nssel_matches_empty": jnp.asarray(ms.nssel_matches_empty),
-        "nssel_invalid": jnp.asarray(ms.nssel_invalid),
-        "nssel_ml": jnp.asarray(ms.nssel_ml),
-        "nssel_expr": jnp.asarray(ms.nssel_expr),
-        "nssel_expr_vals": jnp.asarray(ms.nssel_expr_vals),
+        "kind_rows": np.asarray(ms.kind_rows),
+        "ns_has": np.asarray(ms.ns_has),
+        "ns_ids": np.asarray(ms.ns_ids),
+        "excl_has": np.asarray(ms.excl_has),
+        "excl_ids": np.asarray(ms.excl_ids),
+        "scope": np.asarray(ms.scope),
+        "lab_invalid": np.asarray(ms.lab_invalid),
+        "lab_ml": np.asarray(ms.lab_ml),
+        "lab_expr": np.asarray(ms.lab_expr),
+        "lab_expr_vals": np.asarray(ms.lab_expr_vals),
+        "nssel_has": np.asarray(ms.nssel_has),
+        "nssel_matches_empty": np.asarray(ms.nssel_matches_empty),
+        "nssel_invalid": np.asarray(ms.nssel_invalid),
+        "nssel_ml": np.asarray(ms.nssel_ml),
+        "nssel_expr": np.asarray(ms.nssel_expr),
+        "nssel_expr_vals": np.asarray(ms.nssel_expr_vals),
     }
+
+
+def matchspec_to_device(ms: MatchSpecSet) -> dict:
+    return {k: jnp.asarray(v) for k, v in matchspec_to_np(ms).items()}
 
 
 def features_to_device(fb) -> dict:

@@ -298,6 +298,10 @@ class FeatureBatch:
     nssel_defined: np.ndarray
     nssel_labels: np.ndarray
     nssel_empty: np.ndarray
+    # [N] true when an explicit max_labels truncated any of this review's
+    # label rows — truncated selectors can falsely miss; callers must
+    # route flagged rows to the oracle path
+    label_overflow: np.ndarray = None
 
     @property
     def n(self) -> int:
@@ -324,6 +328,13 @@ def batch_review_features(
         default=1,
     )
     ml = max_labels if max_labels is not None else _bucket(max(longest, 1), lo=4)
+    label_overflow = np.array(
+        [
+            max(len(f.obj_labels), len(f.old_labels), len(f.nssel_labels)) > ml
+            for f in feats
+        ],
+        bool,
+    )
     return FeatureBatch(
         group_id=np.array([f.group_id for f in feats], np.int32),
         kind_id=np.array([f.kind_id for f in feats], np.int32),
@@ -338,4 +349,5 @@ def batch_review_features(
         nssel_defined=np.array([f.nssel_defined for f in feats], bool),
         nssel_labels=_stack_labels([f.nssel_labels for f in feats], ml),
         nssel_empty=np.array([f.nssel_empty for f in feats], bool),
+        label_overflow=label_overflow,
     )

@@ -1,0 +1,11 @@
+"""Multi-chip sharding for the audit kernel.
+
+The reference scales by running whole replicas per pod
+(pkg/operations/operations.go:15-19) with each holding full policy state;
+the TPU build shards the **resource axis** across chips and replicates
+the (small) policy tensors, per SURVEY §2.4 — plus an optional
+constraint-axis shard for very large constraint populations. See
+`sharding.FusedAuditKernel`.
+"""
+
+from .sharding import FusedAuditKernel, audit_mesh  # noqa: F401

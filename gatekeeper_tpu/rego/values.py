@@ -72,6 +72,10 @@ def freeze(v: Any) -> Any:
     """JSON-ish Python value -> frozen canonical value."""
     if v is None or isinstance(v, (bool, int, float, str)):
         return v
+    if isinstance(v, Obj):
+        # Obj is only ever built over frozen contents — re-freezing a
+        # cached subtree (e.g. the audit inventory) must be O(1)
+        return v
     if isinstance(v, (list, tuple)):
         return tuple(freeze(x) for x in v)
     if isinstance(v, (set, frozenset)):

@@ -9,12 +9,22 @@ via __graft_entry__.dryrun_multichip).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force, not setdefault: the axon TPU tunnel env exports
+# JAX_PLATFORMS=axon, and tests must run on the deterministic local
+# 8-device CPU mesh (the real chip is exercised by bench.py / the driver)
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# the axon sitecustomize registers its PJRT plugin at interpreter start
+# and sets jax.config jax_platforms="axon,cpu", which outranks the env
+# var — override at the config level before any backend initializes
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

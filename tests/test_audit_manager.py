@@ -1,0 +1,143 @@
+"""Audit manager tests: sweep over a 10k-object corpus with cap,
+truncation, status publication, and cadence (pkg/audit/manager.go
+behavioral contract)."""
+
+import threading
+
+import pytest
+
+from gatekeeper_tpu.audit import AuditManager, InMemorySink
+from gatekeeper_tpu.audit.manager import truncate_message
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget, TpuDriver
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+REQ_LABELS = """package authlabels
+
+violation[{"msg": msg, "details": {"missing": missing}}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("required labels are missing on this object: %v (policy note: %v)", [missing, input.parameters.note])
+}
+"""
+
+
+def template(kind, rego):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    }
+
+
+def constraint(kind, name, params):
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": {
+            "match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+            "parameters": params,
+        },
+    }
+
+
+def pod(i, labels):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": f"p{i}", "namespace": "default", "labels": labels},
+        "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+    }
+
+
+N_CORPUS = 10_000
+N_BAD = 57  # pods missing the required label (> the 20-violation cap)
+
+
+@pytest.fixture(scope="module")
+def manager():
+    client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    client.add_template(template("AuthLabels", REQ_LABELS))
+    long_note = "x" * 400  # forces messages past the 256-byte cap
+    client.add_constraint(
+        constraint(
+            "AuthLabels", "need-owner",
+            {"labels": ["owner"], "note": long_note},
+        )
+    )
+    for i in range(N_CORPUS):
+        labels = {"app": "a"}
+        if i % (N_CORPUS // N_BAD + 1) != 0 or i >= N_BAD * 200:
+            labels["owner"] = "team"
+        client.add_data(pod(i, labels))
+    sink = InMemorySink()
+    return AuditManager(client, TARGET, sink=sink), sink, client
+
+
+def test_sweep_counts_and_cap(manager):
+    mgr, sink, client = manager
+    report = mgr.audit()
+    st = report.statuses["AuthLabels/need-owner"]
+    assert st.total_violations > 20
+    assert len(st.violations) == 20  # capped detail list
+    assert report.total_violations == st.total_violations
+    assert report.by_enforcement_action == {"deny": st.total_violations}
+    assert report.duration_seconds > 0
+    assert sink.latest is report
+
+
+def test_messages_truncated(manager):
+    mgr, sink, _ = manager
+    report = mgr.audit()
+    st = report.statuses["AuthLabels/need-owner"]
+    for v in st.violations:
+        assert len(v.message) <= 256
+        assert v.message.endswith("...")
+        assert v.name.startswith("p")
+        assert v.namespace == "default"
+
+
+def test_truncate_message_rules():
+    assert truncate_message("short") == "short"
+    assert truncate_message("a" * 256) == "a" * 256
+    long = truncate_message("a" * 300)
+    assert long == "a" * 253 + "..." and len(long) == 256
+    # tiny caps skip the -3 adjustment (manager.go:562-565)
+    assert truncate_message("abcdef", 3) == "abc..."
+
+
+def test_sweep_loop_runs_on_interval():
+    # tiny corpus: the loop cadence is what's under test here
+    client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    client.add_template(template("AuthLabels", REQ_LABELS))
+    client.add_constraint(
+        constraint("AuthLabels", "need-owner", {"labels": ["owner"], "note": "n"})
+    )
+    client.add_data(pod(0, {"app": "a"}))
+    sink = InMemorySink()
+    mgr = AuditManager(client, TARGET, sink=sink, audit_interval=0.05)
+    mgr.audit()  # warm the jit/encode caches before timing the cadence
+    n0 = len(sink.reports)
+    mgr.start()
+    try:
+        threading.Event().wait(1.5)
+        assert len(sink.reports) >= n0 + 2
+    finally:
+        mgr.stop()
+
+
+def test_second_sweep_reuses_encoded_corpus(manager):
+    """Steady-state sweeps must not re-encode the 10k corpus."""
+    mgr, _, client = manager
+    drv = client._driver
+    mgr.audit()
+    c1 = drv._corpus[TARGET]
+    mgr.audit()
+    assert drv._corpus[TARGET] is c1

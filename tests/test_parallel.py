@@ -1,0 +1,76 @@
+"""Multi-device sharding tests on the virtual 8-CPU mesh (conftest).
+
+Pins: sharded fused-audit output == single-device output, for both the
+1-D resource shard and the 2-D constraint x resource mesh; and a
+TpuDriver constructed over a mesh produces Client results identical to
+the unsharded driver. SURVEY §2.4 rows 1/4 (resource-axis sharding,
+replicated policy tensors).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+
+needs_8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 local devices"
+)
+
+
+def _state(n_resources, mesh=None):
+    import __graft_entry__ as ge
+
+    return ge._build_driver(n_resources, mesh=mesh)
+
+
+@needs_8
+@pytest.mark.parametrize("c_shards", [1, 2])
+def test_sharded_matches_single_device(c_shards):
+    from gatekeeper_tpu.parallel import audit_mesh
+
+    mesh = audit_mesh(8, c_shards=c_shards)
+    drv_s, _, cs_s, corpus_s = _state(19, mesh=mesh)
+    m_s, c_s, t_s = drv_s.kernel.run(
+        cs_s.programs, cs_s.ms, corpus_s.fb_dev, corpus_s.tok, corpus_s.g
+    )
+    drv_1, _, cs_1, corpus_1 = _state(19, mesh=None)
+    m_1, c_1, t_1 = drv_1.kernel.run(
+        cs_1.programs, cs_1.ms, corpus_1.fb_dev, corpus_1.tok, corpus_1.g
+    )
+    assert np.array_equal(m_s, m_1)
+    assert np.array_equal(c_s, c_1)
+    assert np.array_equal(t_s, t_1)
+
+
+@needs_8
+def test_sharded_driver_audit_identical():
+    from gatekeeper_tpu.parallel import audit_mesh
+
+    mesh = audit_mesh(8, c_shards=2)
+    _, client_s, _, _ = _state(25, mesh=mesh)
+    _, client_1, _, _ = _state(25, mesh=None)
+    TARGET = "admission.k8s.gatekeeper.sh"
+    res_s = client_s.audit().by_target[TARGET].results
+    res_1 = client_1.audit().by_target[TARGET].results
+    key = lambda r: (r.msg, (r.constraint.get("metadata") or {}).get("name"))
+    assert sorted(map(key, res_s)) == sorted(map(key, res_1))
+    assert res_s
+
+
+@needs_8
+def test_dryrun_multichip():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+
+
+def test_entry_compiles():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    match, counts, totals = out
+    assert match.shape == (2, 16)
+    assert counts.shape == (2, 16)
+    assert totals.shape == (2,)

@@ -148,7 +148,8 @@ def make_env():
     return vocab, patterns, tables
 
 
-def compile_and_count(src, params, reviews, oracle_interp=None, pkg=None):
+def compile_and_count(src, params, reviews, oracle_interp=None, pkg=None,
+                      use_jax=False):
     vocab, patterns, tables = make_env()
     mod = parse_module(src)
     rewrite_module(mod)
@@ -187,6 +188,9 @@ def compile_and_count(src, params, reviews, oracle_interp=None, pkg=None):
         "vid": table.vid,
         "vnum": table.vnum,
     }
+    if use_jax:
+        ev = ProgramEvaluator(patterns, tables, use_jax=True)
+        return ev.eval_jax([prog], tok, g=8)[0]
     ev = ProgramEvaluator(patterns, tables, use_jax=False)
     return ev.eval_np(prog, tok, g=8)
 
@@ -204,10 +208,12 @@ def oracle_count(src, params, reviews):
     return np.array(out), interp, ".".join(pkg)
 
 
-def assert_template_agrees(src_path, params, reviews=PODS):
+def assert_template_agrees(src_path, params, reviews=PODS, use_jax=False):
     src = load_template_rego(src_path)
     want, interp, pkg = oracle_count(src, params, reviews)
-    got = compile_and_count(src, params, reviews, oracle_interp=interp, pkg=pkg)
+    got = compile_and_count(
+        src, params, reviews, oracle_interp=interp, pkg=pkg, use_jax=use_jax
+    )
     if not np.array_equal(got, want):
         bad = [
             (i, int(got[i]), int(want[i]))
@@ -280,3 +286,226 @@ def test_container_limits():
         f"{LIB}/general/containerlimits/src.rego",
         {"cpu": "1", "memory": "2Gi"},
     )
+
+
+# ---------------------------------------------------------------------------
+# Full-library battery: every library/*/*/src.rego template either
+# differentially matches the oracle (numpy AND jax backends) or is
+# asserted to raise CompileUnsupported (-> interpreter fallback in the
+# TPU driver). VERDICT r1 item 4.
+
+EXTRA_PODS = [
+    # probes (requiredprobes)
+    pod(containers=[ctr("np")]),
+    pod(containers=[ctr("lp", extra={"livenessProbe": {"tcpSocket": {"port": 1}}})]),
+    pod(containers=[ctr(
+        "both",
+        extra={
+            "livenessProbe": {"tcpSocket": {"port": 1}},
+            "readinessProbe": {"httpGet": {"path": "/", "port": 2}},
+        },
+    )]),
+    pod(containers=[ctr("empty", extra={"livenessProbe": {}})]),
+    # resource ratios (containerresourceratios)
+    pod(containers=[ctr(resources={
+        "limits": {"cpu": "4", "memory": "4Gi"},
+        "requests": {"cpu": "1", "memory": "1Gi"},
+    })]),
+    pod(containers=[ctr(resources={
+        "limits": {"cpu": "1", "memory": "1Gi"},
+        "requests": {"cpu": "1", "memory": "1Gi"},
+    })]),
+    pod(containers=[ctr(resources={"limits": {"cpu": "2"}, "requests": {}})]),
+    # privilege escalation
+    pod(containers=[ctr(sc={"allowPrivilegeEscalation": False})]),
+    pod(containers=[ctr(sc={"allowPrivilegeEscalation": True})]),
+    # proc mount
+    pod(containers=[ctr(sc={"procMount": "Unmasked"})]),
+    pod(containers=[ctr(sc={"procMount": "Default"})]),
+    # read-only rootfs
+    pod(containers=[ctr(sc={"readOnlyRootFilesystem": True})]),
+    pod(containers=[ctr(sc={"readOnlyRootFilesystem": False})]),
+    # selinux (pod + container level)
+    pod(
+        containers=[ctr(sc={"seLinuxOptions": {"level": "s0", "role": "r"}})],
+        spec_extra={"securityContext": {"seLinuxOptions": {"level": "s1"}}},
+    ),
+    pod(containers=[ctr()], spec_extra={
+        "securityContext": {"seLinuxOptions": {"level": "s0"}}
+    }),
+    # users (runAsUser)
+    pod(containers=[ctr(sc={"runAsUser": 5})]),
+    pod(containers=[ctr(sc={"runAsUser": 0})]),
+    pod(
+        containers=[ctr()],
+        spec_extra={"securityContext": {"runAsUser": 100}},
+    ),
+    # sysctls
+    pod(containers=[ctr()], spec_extra={
+        "securityContext": {"sysctls": [
+            {"name": "kernel.shm_rmid_forced", "value": "0"},
+            {"name": "net.core.somaxconn", "value": "1024"},
+        ]}
+    }),
+    # fsgroup
+    pod(containers=[ctr()], spec_extra={"securityContext": {"fsGroup": 5}}),
+    pod(containers=[ctr()], spec_extra={"securityContext": {"fsGroup": 2000}}),
+    # volumes / flexvolumes / hostPath
+    pod(containers=[ctr()], spec_extra={"volumes": [
+        {"name": "v1", "hostPath": {"path": "/tmp/x"}},
+        {"name": "v2", "configMap": {"name": "cm"}},
+    ]}),
+    pod(containers=[ctr()], spec_extra={"volumes": [
+        {"name": "fv", "flexVolume": {"driver": "example/cifs"}},
+    ]}),
+    pod(
+        containers=[ctr(extra={"volumeMounts": [
+            {"name": "hp", "mountPath": "/data"},
+        ]})],
+        spec_extra={"volumes": [
+            {"name": "hp", "hostPath": {"path": "/etc/foo"}},
+        ]},
+    ),
+    # host network/ports
+    pod(containers=[ctr(extra={"ports": [{"containerPort": 80, "hostPort": 80}]})],
+        spec_extra={"hostNetwork": True}),
+    pod(containers=[ctr(extra={"ports": [{"containerPort": 9000, "hostPort": 9000}]})]),
+    # seccomp/apparmor style annotations (exercises fallback templates'
+    # corpora too once they compile)
+    {
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": "ann",
+        "namespace": "default",
+        "object": {
+            "metadata": {
+                "name": "ann",
+                "annotations": {
+                    "seccomp.security.alpha.kubernetes.io/pod": "runtime/default",
+                    "container.seccomp.security.alpha.kubernetes.io/c1": "localhost/x",
+                    "container.apparmor.security.beta.kubernetes.io/c1": "runtime/default",
+                },
+            },
+            "spec": {"containers": [{"name": "c1", "image": "nginx"}]},
+        },
+    },
+]
+
+ALL_PODS = PODS + EXTRA_PODS
+
+# template dir (under library/) -> list of param sets to test; None in
+# FALLBACK means the compiler must raise CompileUnsupported for it
+TEMPLATE_PARAMS = {
+    "general/allowedrepos": [{"repos": ["gcr.io/mine"]}, {"repos": []}],
+    "general/containerlimits": [{"cpu": "1", "memory": "2Gi"}],
+    "general/containerresourceratios": [{"ratio": "2"}, {"ratio": "4.0"}],
+    "general/httpsonly": [{}],
+    "general/requiredlabels": [
+        {"labels": [{"key": "gatekeeper", "allowedRegex": "^[a-z]+$"}]},
+    ],
+    "general/requiredprobes": [
+        {"probes": ["livenessProbe", "readinessProbe"],
+         "probeTypes": ["tcpSocket", "httpGet", "exec"]},
+        {"probes": ["livenessProbe"], "probeTypes": ["httpGet"]},
+    ],
+    "pod-security-policy/allow-privilege-escalation": [{}],
+    "pod-security-policy/capabilities": [
+        {"allowedCapabilities": ["CHOWN"], "requiredDropCapabilities": ["ALL"]},
+    ],
+    "pod-security-policy/flexvolume-drivers": [
+        {"allowedFlexVolumes": [{"driver": "example/cifs"}]},
+        {"allowedFlexVolumes": []},
+    ],
+    "pod-security-policy/forbidden-sysctls": [
+        {"forbiddenSysctls": ["kernel.shm_rmid_forced"]},
+        {"forbiddenSysctls": ["net.*"]},
+        {"forbiddenSysctls": ["*"]},
+    ],
+    "pod-security-policy/fsgroup": [
+        {"rule": "MustRunAs", "ranges": [{"min": 1, "max": 10}]},
+        {"rule": "MayRunAs", "ranges": [{"min": 1, "max": 1999}]},
+        {"rule": "RunAsAny"},
+    ],
+    "pod-security-policy/host-namespaces": [{}],
+    "pod-security-policy/host-network-ports": [
+        {"hostNetwork": False, "min": 0, "max": 100},
+        {"hostNetwork": True, "min": 80, "max": 8080},
+    ],
+    "pod-security-policy/privileged-containers": [{}],
+    "pod-security-policy/proc-mount": [
+        # "*" is not a testable param: get_allowed_proc_mount's clauses 3
+        # and 4 both fire for it (conflicting outputs — an eval error in
+        # OPA as well)
+        {"procMount": "Default"}, {"procMount": "Unmasked"},
+    ],
+    "pod-security-policy/read-only-root-filesystem": [{}],
+    "pod-security-policy/selinux": [
+        {"allowedSELinuxOptions": [{"level": "s0"}]},
+        {"allowedSELinuxOptions": [{"level": "s0", "role": "r"}]},
+    ],
+    "pod-security-policy/users": [
+        {"runAsUser": {"rule": "MustRunAs", "ranges": [{"min": 1, "max": 10}]}},
+        {"runAsUser": {"rule": "MustRunAsNonRoot"}},
+        {"runAsUser": {"rule": "RunAsAny"}},
+    ],
+    "pod-security-policy/volumes": [
+        {"volumes": ["configMap", "secret"]},
+        {"volumes": ["*"]},
+    ],
+}
+
+# outside the compilable subset -> must raise CompileUnsupported (the
+# TPU driver then routes these templates to the interpreter; pinned in
+# tests/test_tpu_driver.py)
+FALLBACK_TEMPLATES = {
+    "general/uniqueingresshost": {},        # data.inventory join
+    "general/uniqueserviceselector": {},    # data.inventory join
+    "pod-security-policy/apparmor":         # annotations x containers join
+        {"allowedProfiles": ["runtime/default"]},
+    "pod-security-policy/seccomp":
+        {"allowedProfiles": ["runtime/default"]},
+    "pod-security-policy/host-filesystem":  # volumes x volumeMounts join
+        {"allowedHostPaths": [{"pathPrefix": "/tmp", "readOnly": True}]},
+}
+
+
+def _all_template_dirs():
+    import glob as _glob
+
+    dirs = []
+    for src in sorted(_glob.glob(f"{LIB}/*/*/src.rego")):
+        d = os.path.dirname(src)
+        dirs.append(os.path.relpath(d, LIB))
+    return dirs
+
+
+def test_template_inventory_is_exhaustive():
+    """Every library template is either differentially tested or
+    explicitly registered as an interpreter-fallback template."""
+    known = set(TEMPLATE_PARAMS) | set(FALLBACK_TEMPLATES)
+    assert set(_all_template_dirs()) == known
+
+
+@pytest.mark.parametrize(
+    "tdir,params",
+    [(t, p) for t, ps in sorted(TEMPLATE_PARAMS.items()) for p in ps],
+    ids=lambda v: v if isinstance(v, str) else repr(v)[:40],
+)
+@pytest.mark.parametrize("use_jax", [False, True], ids=["np", "jax"])
+def test_library_template_compiled(tdir, params, use_jax):
+    assert_template_agrees(
+        f"{LIB}/{tdir}/src.rego", params, reviews=ALL_PODS, use_jax=use_jax
+    )
+
+
+@pytest.mark.parametrize("tdir", sorted(FALLBACK_TEMPLATES), ids=str)
+def test_library_template_fallback(tdir):
+    src = load_template_rego(f"{LIB}/{tdir}/src.rego")
+    params = FALLBACK_TEMPLATES[tdir]
+    vocab, patterns, tables = make_env()
+    mod = parse_module(src)
+    rewrite_module(mod)
+    env = CompilerEnv(vocab, patterns, tables)
+    from gatekeeper_tpu.engine.programs import compile_program as _cp
+
+    with pytest.raises(CompileUnsupported):
+        _cp(env, [mod], params)
