@@ -311,6 +311,35 @@ class Client:
             responses.by_target[name] = resp
         return responses
 
+    def review_many(
+        self, objs: Sequence[Any], tracing: bool = False
+    ) -> List[Responses]:
+        """Batched review: one driver dispatch for the whole batch (the
+        micro-batching webhook's entry point; the reference client has no
+        equivalent — its webhook evaluates one request per goroutine,
+        pkg/webhook/policy.go:141)."""
+        out: List[Responses] = [Responses() for _ in objs]
+        for name, handler in self.targets.items():
+            idxs: List[int] = []
+            inputs: List[Any] = []
+            for i, obj in enumerate(objs):
+                handled, review = handler.handle_review(obj)
+                if not handled:
+                    continue
+                idxs.append(i)
+                inputs.append({"review": review})
+            if not inputs:
+                continue
+            resps = self._driver.query_many(
+                f'hooks["{name}"].violation', inputs, tracing
+            )
+            for i, resp in zip(idxs, resps):
+                for r in resp.results:
+                    handler.handle_violation(r)
+                resp.target = name
+                out[i].by_target[name] = resp
+        return out
+
     def audit(self, tracing: bool = False) -> Responses:
         responses = Responses()
         for name, handler in self.targets.items():

@@ -71,6 +71,14 @@ class Driver(ABC):
         self, path: str, input: Any = None, tracing: bool = False
     ) -> Response: ...
 
+    def query_many(
+        self, path: str, inputs: Sequence[Any], tracing: bool = False
+    ) -> List[Response]:
+        """Batched query: engines without a batch path evaluate serially;
+        the TPU driver overrides this with one fused dispatch (the
+        micro-batching webhook's entry point)."""
+        return [self.query(path, i, tracing) for i in inputs]
+
     @abstractmethod
     def dump(self) -> str: ...
 

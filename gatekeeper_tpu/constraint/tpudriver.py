@@ -62,7 +62,7 @@ from ..rego.interp import RegoError, Undefined, _call_function
 from ..rego.values import freeze, thaw
 from . import match as M
 from .driver import RegoDriver, _cname
-from .types import Result
+from .types import Response, Result
 
 _TEMPLATE_PREFIX_RE = re.compile(r'^templates\["([^"]+)"\]\["([^"]+)"\]$')
 
@@ -75,7 +75,7 @@ G_CAP = 64
 # Resource-axis chunk for device dispatch: bounds the [N, L, G]
 # intermediates EGroup materializes and keeps one stable jit shape that
 # every chunk (padded) reuses.
-N_CHUNK = 8192
+N_CHUNK = 32768
 
 
 def _params_key(params: Any) -> str:
@@ -92,6 +92,9 @@ class _Corpus:
     fb_dev: Dict[str, Any]
     g: int
     row_fallback: np.ndarray  # [N] bool: route row to interpreter
+    # [(start, StagedBatch)] device-resident chunks; staged lazily at
+    # first dispatch, reused every sweep until the corpus changes
+    staged: Optional[List[Tuple[int, Any]]] = None
 
 
 @dataclass
@@ -103,6 +106,7 @@ class _ConstraintSet:
     ms: Dict[str, np.ndarray]
     programs: List[Optional[Program]]  # index-aligned; None => fallback
     prog_rows: List[int]  # constraint index -> row in compiled stack (-1)
+    policy: Optional[Any] = None  # StagedPolicy, device-resident
 
 
 class TpuDriver(RegoDriver):
@@ -328,21 +332,15 @@ class TpuDriver(RegoDriver):
 
     # -- device dispatch -----------------------------------------------------
 
-    def _match_and_counts(
-        self, cs: _ConstraintSet, corpus: _Corpus, ns_cache: Dict[str, Any]
-    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
-        """[C, N] match matrix and [Cc, N] violation counts (None when no
-        program compiled), evaluated in resource-axis chunks."""
-        compiled = [p for p in cs.programs if p is not None]
+    def _stage_corpus(self, corpus: _Corpus) -> List[Tuple[int, Any]]:
+        """Slice/pad the encoded corpus into fixed-shape chunks and ship
+        them to device once; sweeps then dispatch against resident
+        operands (no host->device traffic in steady state)."""
+        if corpus.staged is not None:
+            return corpus.staged
         n = len(corpus.reviews)
-        if not self.use_jax:
-            return self._match_and_counts_np(cs, corpus, compiled, n, ns_cache)
-
-        match_out = np.zeros((len(cs.constraints), n), bool)
-        counts_out = (
-            np.zeros((len(compiled), n), np.int32) if compiled else None
-        )
         chunk = min(N_CHUNK, _bucket(n, lo=64))
+        staged = []
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
             pad = chunk - (end - start)
@@ -354,28 +352,64 @@ class TpuDriver(RegoDriver):
                 k: _pad_rows(v[start:end], pad, fill=0 if k == "vnum" else -1)
                 for k, v in corpus.tok.items()
             }
-            # ONE fused dispatch per chunk: match kernel + all programs
-            m, c, _ = self.kernel.run(cs.programs, cs.ms, fb_c, tok_c, corpus.g)
-            match_out[:, start:end] = m[:, : end - start]
-            if compiled:
-                counts_out[:, start:end] = c[:, : end - start]
-        return match_out, counts_out
+            batch = self.kernel.stage_batch(
+                fb_c, tok_c, corpus.row_fallback[start:end], end - start
+            )
+            staged.append((start, batch))
+        corpus.staged = staged
+        return staged
 
-    def _match_and_counts_np(self, cs, corpus, compiled, n, ns_cache):
-        """Numpy path (use_jax=False): same semantics, eager host eval —
-        used by tests that pin device/host equivalence."""
-        match_out = np.zeros((len(cs.constraints), n), bool)
+    def _need_pairs(
+        self, cs: _ConstraintSet, corpus: _Corpus
+    ) -> Tuple[List[Tuple[int, int]], int, int]:
+        """Sparse evaluation: -> (review-major (n, c) pairs needing
+        interpreter work, compiled_pairs, interp_pairs)."""
+        if cs.policy is None:
+            cs.policy = self.kernel.stage_policy(cs.programs, cs.ms)
+        policy = cs.policy
+        pairs: List[Tuple[int, int]] = []
+        stat_c = stat_i = 0
+        for start, batch in self._stage_corpus(corpus):
+            k_cap = 1 << 14
+            while True:
+                idx, n_need, sc, si = self.kernel.dispatch_need(
+                    policy, batch, corpus.g, k_cap
+                )
+                if n_need <= k_cap:
+                    break
+                k_cap = 1 << (int(n_need) - 1).bit_length()
+            stat_c += sc
+            stat_i += si
+            flats = idx[:n_need]
+            n_loc, c_is = np.divmod(flats, policy.c_pad)
+            pairs.extend(zip((start + n_loc).tolist(), c_is.tolist()))
+        return pairs, stat_c, stat_i
+
+    def _need_pairs_np(self, cs, corpus, ns_cache, n):
+        """Numpy path (use_jax=False): same pair semantics, eager host
+        eval — used by tests that pin device/host equivalence."""
+        compiled = [p for p in cs.programs if p is not None]
+        match = np.zeros((len(cs.constraints), n), bool)
         for i, c in enumerate(cs.constraints):
             for j, r in enumerate(corpus.reviews):
-                match_out[i, j] = M.matches_constraint(c, r, ns_cache)
-        counts_out = None
+                match[i, j] = M.matches_constraint(c, r, ns_cache)
+        prog_rows_arr = np.asarray(cs.prog_rows, np.int64)
+        compiled_c = prog_rows_arr >= 0
+        row_fb = np.asarray(corpus.row_fallback[:n], bool)
+        viol = np.zeros((len(cs.constraints), n), bool)
         if compiled:
-            rows = [
-                self.evaluator.eval_np(p, corpus.tok, g=corpus.g)
-                for p in compiled
-            ]
-            counts_out = np.stack(rows, axis=0).astype(np.int32)
-        return match_out, counts_out
+            counts = np.stack(
+                [self.evaluator.eval_np(p, corpus.tok, g=corpus.g)
+                 for p in compiled],
+                axis=0,
+            )
+            viol[compiled_c] = counts[prog_rows_arr[compiled_c]] > 0
+        fallback_pair = ~compiled_c[:, None] | row_fb[None, :]
+        need = match & (viol | fallback_pair)
+        pairs = [(int(a), int(b)) for a, b in np.argwhere(need.T)]
+        stat_c = int((match & ~fallback_pair).sum())
+        stat_i = int((match & fallback_pair).sum())
+        return pairs, stat_c, stat_i
 
     # -- hook overrides ------------------------------------------------------
 
@@ -406,6 +440,52 @@ class TpuDriver(RegoDriver):
         )
         return results
 
+    def query_many(
+        self, path: str, inputs: Sequence[Any], tracing: bool = False
+    ) -> List[Response]:
+        """Batched violation hook: every review in `inputs` evaluates in
+        one fused device dispatch (the webhook micro-batch path). Other
+        hooks and tracing queries fall back to the serial default."""
+        from .driver import _HOOK_RE
+
+        m = _HOOK_RE.match(path)
+        if (
+            m is None
+            or m.group(2) != "violation"
+            or tracing
+            or not self.use_jax
+        ):
+            return super().query_many(path, inputs, tracing)
+        target = m.group(1)
+        with self._mutex:
+            constraints = self._constraints(target)
+            ns_cache = self._ns_cache(target)
+            reviews = [
+                M.hook_get_default(i or {}, "review", {}) for i in inputs
+            ]
+            autorejects: List[List[Result]] = []
+            for review in reviews:
+                out: List[Result] = []
+                for constraint in constraints:
+                    if M.autoreject(constraint, review, ns_cache):
+                        out.append(
+                            Result(
+                                msg="Namespace is not cached in OPA.",
+                                metadata={"details": {}},
+                                constraint=constraint,
+                                review=review,
+                                enforcement_action=M.enforcement_action(
+                                    constraint
+                                ),
+                            )
+                        )
+                autorejects.append(out)
+            split = self._eval_reviews_split(target, reviews, None, None)
+        return [
+            Response(target=target, results=auto + ev)
+            for auto, ev in zip(autorejects, split)
+        ]
+
     def _audit(self, target: str, trace: Optional[List[str]]) -> List[Result]:
         with self._mutex:
             corpus = self._audit_corpus(target)
@@ -423,13 +503,24 @@ class TpuDriver(RegoDriver):
         trace: Optional[List[str]],
         corpus: Optional[_Corpus],
     ) -> List[Result]:
+        split = self._eval_reviews_split(target, reviews, trace, corpus)
+        return [r for sub in split for r in sub]
+
+    def _eval_reviews_split(
+        self,
+        target: str,
+        reviews: List[Any],
+        trace: Optional[List[str]],
+        corpus: Optional[_Corpus],
+    ) -> List[List[Result]]:
         """Shared compiled-path evaluation: match x programs on device,
-        interpreter rendering of the sparse violating pairs."""
+        interpreter rendering of the sparse violating pairs; results
+        grouped per review (review-major order preserved)."""
         with self._mutex:
             cs = self._constraint_set(target)
             if cs is None:
                 self.stats = {}
-                return []
+                return [[] for _ in reviews]
             ns_cache = self._ns_cache(target)
             inventory = self._inventory(target)
             if corpus is None:
@@ -446,47 +537,39 @@ class TpuDriver(RegoDriver):
                 )
             self.patterns.sync()
             self.tables.sync()
-            match, counts = self._match_and_counts(cs, corpus, ns_cache)
-
-            # vectorized pair selection: only the sparse set of pairs that
-            # need interpreter work is visited in Python — violating
-            # compiled pairs (count > 0) plus every matched fallback pair
             c_count = len(cs.constraints)
             n_count = len(reviews)
-            prog_rows_arr = np.asarray(cs.prog_rows, np.int64)  # [C]
-            compiled_c = prog_rows_arr >= 0  # [C]
-            row_fb = np.asarray(corpus.row_fallback[:n_count], bool)  # [N]
-            viol = np.zeros((c_count, n_count), bool)
-            if counts is not None and compiled_c.any():
-                viol[compiled_c] = counts[prog_rows_arr[compiled_c]] > 0
-            fallback_pair = ~compiled_c[:, None] | row_fb[None, :]
-            need = match & (viol | fallback_pair)
-            # review-major emit order (matches RegoDriver._audit's loop)
-            pairs = np.argwhere(need.T)
-            results: List[Result] = []
-            for n_i, c_i in pairs:
-                results.extend(
-                    self._eval_template(
-                        target,
-                        cs.constraints[c_i],
-                        reviews[n_i],
-                        inventory,
-                        trace,
-                    )
+            if self.use_jax:
+                pairs, stat_c, stat_i = self._need_pairs(cs, corpus)
+            else:
+                pairs, stat_c, stat_i = self._need_pairs_np(
+                    cs, corpus, ns_cache, n_count
                 )
+            # only the sparse pair set needing interpreter work is
+            # visited in Python — violating compiled pairs (count > 0)
+            # plus every matched fallback pair, review-major (matching
+            # RegoDriver._audit's emit order)
+            per_review: List[List[Result]] = [[] for _ in reviews]
+            n_results = 0
+            for n_i, c_i in pairs:
+                out = self._eval_template(
+                    target, cs.constraints[c_i], reviews[n_i], inventory, trace
+                )
+                per_review[n_i].extend(out)
+                n_results += len(out)
             self.stats = {
-                "compiled_pairs": int((match & ~fallback_pair).sum()),
-                "interp_pairs": int((match & fallback_pair).sum()),
+                "compiled_pairs": stat_c,
+                "interp_pairs": stat_i,
                 "n_reviews": n_count,
                 "n_constraints": c_count,
-                "n_results": len(results),
+                "n_results": n_results,
             }
             if trace is not None:
                 trace.append(
                     f"tpu dispatch: {self.stats['compiled_pairs']} compiled "
                     f"pairs, {self.stats['interp_pairs']} interpreter pairs"
                 )
-            return results
+            return per_review
 
 
 def _features_np(fb) -> Dict[str, np.ndarray]:

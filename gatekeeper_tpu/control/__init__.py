@@ -1,0 +1,10 @@
+"""Control-plane pieces that don't need a live cluster: process
+exclusion, config handling, readiness tracking.
+
+The reference's equivalents live under pkg/controller/ and pkg/readiness/
+and are wired to the K8s API server; here they are plain objects the
+runner/webhook/audit layers compose.
+"""
+
+from .process import Excluder, PROCESS_AUDIT, PROCESS_SYNC, PROCESS_WEBHOOK, PROCESS_STAR  # noqa: F401
+from .readiness import ReadinessTracker  # noqa: F401

@@ -1,0 +1,13 @@
+"""Metrics registry + Prometheus text exposition.
+
+Counterpart of pkg/metrics/ (OpenCensus views -> Prometheus exporter on
+:8888, exporter.go:26, prometheus_exporter.go:17-40) and the per-package
+stats reporters (webhook/audit/controller stats_reporter.go files). The
+metric names follow the reference's docs/Metrics.md catalogue:
+request_count, request_duration_seconds, violations,
+audit_duration_seconds, audit_last_run_time, constraints,
+constraint_templates, sync, watch_manager_* — tagged with the same
+label keys (admission_status, enforcement_action, status, ...).
+"""
+
+from .registry import MetricsRegistry, serve_metrics  # noqa: F401

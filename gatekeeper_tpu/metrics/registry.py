@@ -1,0 +1,146 @@
+"""In-process metrics: counters, gauges, distributions; Prometheus text
+format exposition over stdlib HTTP."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+
+def _tag_key(tags: Dict[str, Any]) -> Tuple:
+    return tuple(sorted((k, str(v)) for k, v in tags.items()))
+
+
+@dataclass
+class _Dist:
+    count: int = 0
+    total: float = 0.0
+    minimum: float = float("inf")
+    maximum: float = float("-inf")
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        self.minimum = min(self.minimum, v)
+        self.maximum = max(self.maximum, v)
+
+
+class MetricsRegistry:
+    """Record-style API mirroring pkg/metrics/record.go: one call site
+    per measurement, tags as keyword args."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple], float] = {}
+        self._gauges: Dict[Tuple[str, Tuple], float] = {}
+        self._dists: Dict[Tuple[str, Tuple], _Dist] = {}
+
+    # -- write ---------------------------------------------------------------
+
+    def record(self, name: str, value: float = 1, **tags) -> None:
+        """Add to a counter."""
+        key = (name, _tag_key(tags))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + value
+
+    def gauge(self, name: str, value: float, **tags) -> None:
+        key = (name, _tag_key(tags))
+        with self._lock:
+            self._gauges[key] = value
+
+    def observe(self, name: str, value: float, **tags) -> None:
+        """Add a sample to a distribution (latency histograms)."""
+        key = (name, _tag_key(tags))
+        with self._lock:
+            self._dists.setdefault(key, _Dist()).add(value)
+
+    def timed(self, name: str, **tags):
+        """Context manager: records elapsed seconds into `name`."""
+        reg = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                reg.observe(name, time.perf_counter() - self.t0, **tags)
+                return False
+
+        return _Timer()
+
+    # -- read ----------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": {
+                    self._fmt(k): v for k, v in self._counters.items()
+                },
+                "gauges": {self._fmt(k): v for k, v in self._gauges.items()},
+                "distributions": {
+                    self._fmt(k): {
+                        "count": d.count,
+                        "sum": d.total,
+                        "min": d.minimum if d.count else None,
+                        "max": d.maximum if d.count else None,
+                        "avg": d.total / d.count if d.count else None,
+                    }
+                    for k, d in self._dists.items()
+                },
+            }
+
+    @staticmethod
+    def _fmt(key: Tuple[str, Tuple]) -> str:
+        name, tags = key
+        if not tags:
+            return name
+        inner = ",".join(f'{k}="{v}"' for k, v in tags)
+        return f"{name}{{{inner}}}"
+
+    def prometheus_text(self, prefix: str = "gatekeeper_") -> str:
+        """Prometheus exposition format (prometheus_exporter.go's output
+        namespace is "gatekeeper")."""
+        lines = []
+        with self._lock:
+            for (name, tags), v in sorted(self._counters.items()):
+                lines.append(f"{prefix}{self._fmt((name, tags))} {v}")
+            for (name, tags), v in sorted(self._gauges.items()):
+                lines.append(f"{prefix}{self._fmt((name, tags))} {v}")
+            for (name, tags), d in sorted(self._dists.items()):
+                base = self._fmt((name, tags))
+                lines.append(f"{prefix}{base}_count {d.count}")
+                lines.append(f"{prefix}{base}_sum {d.total}")
+        return "\n".join(lines) + "\n"
+
+
+def serve_metrics(
+    registry: MetricsRegistry, port: int = 0
+) -> ThreadingHTTPServer:
+    """Serve /metrics (Prometheus text) on a background thread; returns
+    the server (server_address[1] carries the bound port). The reference
+    serves the same on --prometheus-port 8888."""
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            payload = registry.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd

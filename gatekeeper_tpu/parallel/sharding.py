@@ -26,6 +26,7 @@ padded resource rows are sliced off after gather.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -66,12 +67,49 @@ def _pad_axis(a: np.ndarray, axis: int, mult: int, fill) -> np.ndarray:
     return np.pad(a, widths, constant_values=fill)
 
 
+@dataclass
+class StagedPolicy:
+    """Constraint-side tensors resident on device (staged once per
+    constraint-set change): match specs, grouped program consts, and the
+    compiled-constraint mask."""
+
+    ms_dev: Dict[str, Any]
+    group_exprs: List[Any]
+    group_rows: List[List[int]]
+    stacked_consts: List[Dict[str, Any]]
+    compiled_mask: Any  # [C_pad] bool device
+    prog_rows: List[int]
+    c: int  # true constraint count
+    c_pad: int
+    key: Tuple
+
+
+@dataclass
+class StagedBatch:
+    """Resource-side tensors resident on device (staged once per corpus
+    chunk): review features, token table, and the row-fallback mask."""
+
+    fb_dev: Dict[str, Any]
+    tok_dev: Dict[str, Any]
+    row_fb: Any  # [N_pad] bool device
+    n_valid: int  # true rows in this chunk
+    key: Tuple
+
+
 class FusedAuditKernel:
     """One-dispatch audit: [C, N] match ∧ per-program violation counts.
 
     With a mesh, inputs are placed with NamedShardings and GSPMD
     partitions the compute; without one, it is the plain single-device
     fused dispatch (what TpuDriver uses for its steady-state sweep).
+
+    Two dispatch forms:
+      * `run`/`prepare` — full [C, N] outputs (dryrun/entry/tests);
+      * `stage_policy`/`stage_batch`/`dispatch_need` — device-resident
+        operands + sparse output: only the flat indices of pairs that
+        need host-side interpreter work leave the device (the all-gather
+        of violation indices the north star prescribes; gathering the
+        full matrices over the chip link is what made sweeps slow).
     """
 
     def __init__(
@@ -114,6 +152,193 @@ class FusedAuditKernel:
             arrs = {k: self._put(v) for k, v in arrs.items()}
             self._table_cache = (gen, arrs)
         return self._table_cache[1]
+
+    # -- staged sparse dispatch ---------------------------------------------
+
+    def stage_policy(
+        self,
+        programs: Sequence[Optional[Program]],
+        ms: Dict[str, np.ndarray],
+    ) -> StagedPolicy:
+        c = next(iter(ms.values())).shape[0]
+        c_mult = self.mesh.shape["c"] if self.mesh else 1
+        ms_dev = {
+            k: self._put(_pad_axis(np.asarray(v), 0, c_mult, _ms_fill(k)), "c")
+            for k, v in ms.items()
+        }
+        c_pad = ms_dev["kind_rows"].shape[0]
+        compiled = [p for p in programs if p is not None]
+        prog_rows = []
+        row = 0
+        for p in programs:
+            prog_rows.append(row if p is not None else -1)
+            row += p is not None
+        compiled_mask = np.zeros((c_pad,), bool)
+        compiled_mask[: len(programs)] = [p is not None for p in programs]
+        groups: Dict[Tuple, Dict[str, Any]] = {}
+        for ci, p in enumerate(programs):
+            if p is None:
+                continue
+            gkey = (
+                p.signature,
+                tuple(sorted((k, v.shape) for k, v in p.consts.items())),
+            )
+            grp = groups.setdefault(
+                gkey, {"expr": p.expr, "rows": [], "consts": []}
+            )
+            grp["rows"].append(ci)  # constraint-row index
+            grp["consts"].append(p.consts)
+        group_list = list(groups.values())
+        stacked_consts = [
+            {
+                k: self._put(np.stack([cd[k] for cd in grp["consts"]]))
+                for k in grp["consts"][0]
+            }
+            for grp in group_list
+        ]
+        key = (
+            tuple(groups),
+            tuple(tuple(grp["rows"]) for grp in group_list),
+            c,
+            c_pad,
+            id(self.mesh),
+        )
+        return StagedPolicy(
+            ms_dev=ms_dev,
+            group_exprs=[grp["expr"] for grp in group_list],
+            group_rows=[list(grp["rows"]) for grp in group_list],
+            stacked_consts=stacked_consts,
+            compiled_mask=self._put(compiled_mask, "c"),
+            prog_rows=prog_rows,
+            c=c,
+            c_pad=c_pad,
+            key=key,
+        )
+
+    def stage_batch(
+        self,
+        fb: Dict[str, np.ndarray],
+        tok: Dict[str, np.ndarray],
+        row_fb: np.ndarray,
+        n_valid: int,
+    ) -> StagedBatch:
+        n_mult = self.mesh.shape["n"] if self.mesh else 1
+        fb_dev = {
+            k: self._put(_pad_axis(np.asarray(v), 0, n_mult, _fb_fill(k)), "n")
+            for k, v in fb.items()
+        }
+        tok_dev = {
+            k: self._put(
+                _pad_axis(np.asarray(v), 0, n_mult, 0.0 if k == "vnum" else -1),
+                "n",
+            )
+            for k, v in tok.items()
+        }
+        n_pad = tok_dev["spath"].shape[0]
+        rf = np.zeros((n_pad,), bool)
+        rf[: len(row_fb)] = row_fb
+        return StagedBatch(
+            fb_dev=fb_dev,
+            tok_dev=tok_dev,
+            row_fb=self._put(rf, "n"),
+            n_valid=n_valid,
+            key=(tok_dev["spath"].shape, fb_dev["group_id"].shape, n_pad),
+        )
+
+    def dispatch_need(
+        self,
+        policy: StagedPolicy,
+        batch: StagedBatch,
+        g: int,
+        k_cap: int = 1 << 14,
+    ) -> Tuple[np.ndarray, int, int, int]:
+        """-> (flat pair indices [<=k_cap], n_need, compiled_pairs,
+        interp_pairs) for one staged chunk.
+
+        Flat index = n_local * c_pad + c (review-major). n_need may
+        exceed k_cap (truncated indices): callers re-dispatch with a
+        larger cap. Stats count matched pairs on the compiled vs
+        interpreter routes (valid rows only).
+        """
+        key = ("need", policy.key, batch.key, g, batch.n_valid, k_cap)
+        entry = self._jit_cache.get(key)
+        if entry is None:
+            group_exprs = policy.group_exprs
+            group_rows = policy.group_rows
+            n_valid = batch.n_valid
+
+            def run_need(ms_in, fb_in, tok_in, tabs_in, consts_in,
+                         compiled_mask, row_fb):
+                from ..engine.exprs import EvalCtx
+
+                match = match_matrix(ms_in, fb_in)  # [C, N]
+                str_tabs = {
+                    k: v
+                    for k, v in tabs_in.items()
+                    if k not in ("pat_member", "pat_capture")
+                }
+                viol = jnp.zeros(match.shape, bool)
+                for expr, grows, consts_k in zip(
+                    group_exprs, group_rows, consts_in
+                ):
+
+                    def eval_one(consts):
+                        ctx = EvalCtx(
+                            np=jnp,
+                            tok=tok_in,
+                            pat_member=tabs_in["pat_member"],
+                            pat_capture=tabs_in["pat_capture"],
+                            str_tables=str_tabs,
+                            consts=consts,
+                            g0=g,
+                            g1=g,
+                        )
+                        return expr.emit(ctx).astype(jnp.int32)
+
+                    if consts_k:
+                        out_k = jax.vmap(eval_one)(consts_k) > 0
+                    else:
+                        one = eval_one({}) > 0
+                        out_k = jnp.broadcast_to(
+                            one, (len(grows),) + one.shape
+                        )
+                    viol = viol.at[jnp.asarray(grows)].set(out_k)
+
+                valid_n = jnp.arange(match.shape[1]) < n_valid
+                fallback = (~compiled_mask[:, None]) | row_fb[None, :]
+                need = match & (viol | fallback) & valid_n[None, :]
+                stat_c = jnp.sum(
+                    match & compiled_mask[:, None] & ~row_fb[None, :]
+                    & valid_n[None, :]
+                )
+                stat_i = jnp.sum(match & fallback & valid_n[None, :])
+                need_t = need.T.reshape(-1)  # review-major flat
+                idx = jnp.nonzero(need_t, size=k_cap, fill_value=-1)[0]
+                return (
+                    idx.astype(jnp.int32),
+                    need_t.sum().astype(jnp.int32),
+                    stat_c.astype(jnp.int32),
+                    stat_i.astype(jnp.int32),
+                )
+
+            entry = [run_need, jax.jit(run_need)]
+            self._jit_cache[key] = entry
+        tabs = self._tables_device()
+        idx, n_need, stat_c, stat_i = entry[1](
+            policy.ms_dev,
+            batch.fb_dev,
+            batch.tok_dev,
+            tabs,
+            policy.stacked_consts,
+            policy.compiled_mask,
+            batch.row_fb,
+        )
+        return (
+            np.asarray(idx),
+            int(n_need),
+            int(stat_c),
+            int(stat_i),
+        )
 
     # -- dispatch ------------------------------------------------------------
 

@@ -1,0 +1,11 @@
+"""Admission webhook layer.
+
+Counterpart of pkg/webhook/: the validating handler (policy.go), the
+namespace-label guard (namespacelabel.go), and — new to the TPU build —
+the micro-batching bridge that coalesces concurrent AdmissionReviews
+into one fused device dispatch (SURVEY §2.4 row 3).
+"""
+
+from .policy import AdmissionResponse, ValidationHandler  # noqa: F401
+from .namespacelabel import IGNORE_LABEL, NamespaceLabelHandler  # noqa: F401
+from .server import MicroBatcher, WebhookServer  # noqa: F401

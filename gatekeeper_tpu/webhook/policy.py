@@ -1,0 +1,197 @@
+"""Validating admission handler.
+
+Behavioral mirror of pkg/webhook/policy.go's validationHandler.Handle
+(:141-221) and getDenyMessages (:224-282):
+
+  * Gatekeeper's own service account is always allowed (:146-148);
+  * DELETE reviews the existing object: oldObject replaces object, and a
+    nil oldObject is a 500 (:150-165);
+  * Gatekeeper's own CRs are dry-validated inline — ConstraintTemplates
+    through CreateCRD, constraints through ValidateConstraint +
+    enforcementAction validation (:167-178, :311-351) — user errors are
+    422, internal errors 500;
+  * namespaces excluded for the webhook process are allowed (:191-195);
+  * the Namespace object is fetched and attached to the review
+    (:354-369; here from a pluggable getter over the synced cache);
+  * only `deny` results deny (403, messages joined with newlines);
+    `dryrun` results are logged/counted only (:277-280).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..constraint import AugmentedReview
+from ..constraint.errors import ConstraintFrameworkError
+from ..control import PROCESS_WEBHOOK, Excluder
+
+SERVICE_ACCOUNT_NAMESPACE = "gatekeeper-system"
+SERVICE_ACCOUNT = (
+    f"system:serviceaccount:{SERVICE_ACCOUNT_NAMESPACE}:gatekeeper-admin"
+)
+
+
+@dataclass
+class AdmissionResponse:
+    allowed: bool
+    message: str = ""
+    code: int = 200
+
+    def to_dict(self, uid: Optional[str] = None) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"allowed": self.allowed}
+        if uid is not None:
+            out["uid"] = uid
+        if self.message or self.code != 200:
+            out["status"] = {
+                "code": self.code,
+                "message": self.message,
+            }
+        return out
+
+
+class ValidationHandler:
+    def __init__(
+        self,
+        client,
+        target: str,
+        excluder: Optional[Excluder] = None,
+        namespace_getter: Optional[Callable[[str], Optional[dict]]] = None,
+        log_denies: bool = False,
+        metrics=None,
+    ):
+        self.client = client
+        self.target = target
+        self.excluder = excluder
+        self.namespace_getter = namespace_getter
+        self.log_denies = log_denies
+        self.metrics = metrics
+        self.denied_log: List[Dict[str, Any]] = []
+
+    # -- entry ---------------------------------------------------------------
+
+    def handle(self, request: Dict[str, Any]) -> AdmissionResponse:
+        resp = self._handle(request)
+        if self.metrics is not None:
+            status = (
+                "allow" if resp.allowed
+                else ("error" if resp.code >= 500 else "deny")
+            )
+            self.metrics.record("request_count", 1, admission_status=status)
+        return resp
+
+    def _handle(self, request: Dict[str, Any]) -> AdmissionResponse:
+        user = (request.get("userInfo") or {}).get("username", "")
+        if user == SERVICE_ACCOUNT:
+            return AdmissionResponse(True, "Gatekeeper does not self-manage")
+
+        request = dict(request)
+        if request.get("operation") == "DELETE":
+            if request.get("oldObject") is None:
+                return AdmissionResponse(
+                    False,
+                    "For admission webhooks registered for DELETE operations, "
+                    "please use Kubernetes v1.15.0+.",
+                    code=500,
+                )
+            request["object"] = request.get("oldObject")
+
+        user_err, err = self._validate_gatekeeper_resources(request)
+        if err is not None:
+            return AdmissionResponse(
+                False, str(err), code=422 if user_err else 500
+            )
+
+        namespace = request.get("namespace", "")
+        if (
+            namespace
+            and self.excluder is not None
+            and self.excluder.is_namespace_excluded(PROCESS_WEBHOOK, namespace)
+        ):
+            return AdmissionResponse(
+                True, "Namespace is set to be ignored by Gatekeeper config"
+            )
+
+        try:
+            results = self._review(request)
+        except Exception as e:
+            return AdmissionResponse(False, str(e), code=500)
+
+        msgs = self._deny_messages(results, request)
+        if msgs:
+            return AdmissionResponse(False, "\n".join(msgs), code=403)
+        return AdmissionResponse(True, "")
+
+    # -- pieces --------------------------------------------------------------
+
+    def _review(self, request: Dict[str, Any]) -> List[Any]:
+        review = self._augment(request)
+        responses = self.client.review(review)
+        resp = responses.by_target.get(self.target)
+        return resp.results if resp is not None else []
+
+    def _augment(self, request: Dict[str, Any]) -> AugmentedReview:
+        ns_obj = None
+        namespace = request.get("namespace", "")
+        if namespace and self.namespace_getter is not None:
+            ns_obj = self.namespace_getter(namespace)
+        return AugmentedReview(request, namespace=ns_obj)
+
+    def _deny_messages(
+        self, results: List[Any], request: Dict[str, Any]
+    ) -> List[str]:
+        """getDenyMessages (:224-282): deny messages are
+        '[denied by <constraint>] <msg>'; dryrun results are recorded
+        but never deny."""
+        msgs: List[str] = []
+        for r in results:
+            cname = ((r.constraint or {}).get("metadata") or {}).get(
+                "name", "?"
+            )
+            if r.enforcement_action in ("deny", "dryrun") and self.log_denies:
+                self.denied_log.append(
+                    {
+                        "process": "admission",
+                        "event_type": "violation",
+                        "constraint_name": cname,
+                        "constraint_action": r.enforcement_action,
+                        "resource_namespace": request.get("namespace", ""),
+                        "resource_name": request.get("name", ""),
+                        "msg": r.msg,
+                    }
+                )
+            if r.enforcement_action == "deny":
+                msgs.append(f"[denied by {cname}] {r.msg}")
+        return msgs
+
+    def _validate_gatekeeper_resources(self, request: Dict[str, Any]):
+        """validateGatekeeperResources (:301-351): dry-validate GK's own
+        CRs inline. Returns (user_error, error|None)."""
+        kind = request.get("kind") or {}
+        group = kind.get("group", "")
+        obj = request.get("object")
+        if group == "templates.gatekeeper.sh" and kind.get("kind") == (
+            "ConstraintTemplate"
+        ):
+            try:
+                self.client.create_crd(obj)
+            except ConstraintFrameworkError as e:
+                return True, e
+            except Exception as e:
+                return False, e
+            return False, None
+        if group == "constraints.gatekeeper.sh":
+            try:
+                self.client.validate_constraint(obj)
+            except ConstraintFrameworkError as e:
+                return True, e
+            except Exception as e:
+                return False, e
+            action = ((obj or {}).get("spec") or {}).get("enforcementAction")
+            if action is not None and action not in ("deny", "dryrun"):
+                return False, ValueError(
+                    f"Could not find the provided enforcementAction value "
+                    f"within the supported list: {action!r}"
+                )
+            return False, None
+        return False, None

@@ -1,0 +1,203 @@
+"""Micro-batching admission server.
+
+The reference webhook evaluates one AdmissionReview per goroutine behind
+a shared RWMutex (pkg/webhook/policy.go:141, drivers/local/local.go:303)
+— concurrency without batching. The TPU path inverts that: concurrent
+requests are coalesced for up to `window_ms` (or until `max_batch`) and
+the whole batch is evaluated in ONE fused device dispatch via
+`Client.review_many` (SURVEY §2.4 row 3's micro-batching bridge).
+
+`WebhookServer` is a stdlib HTTP shim serving /v1/admit and
+/v1/admitlabel with AdmissionReview JSON — the in-process stand-in for
+the Go webhook pod; a production deployment would terminate TLS in front
+(the reference's cert rotation lives in its Go control plane,
+pkg/webhook/certs.go).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import Future
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..constraint import AugmentedReview
+from .namespacelabel import NamespaceLabelHandler
+from .policy import AdmissionResponse, ValidationHandler
+
+
+class MicroBatcher:
+    """Collects admission requests into batches for fused evaluation.
+
+    submit() returns a Future resolving to the request's results list.
+    A background worker drains the queue every `window_ms` (or as soon
+    as `max_batch` requests are pending) and runs one
+    `Client.review_many` call for the whole batch.
+    """
+
+    def __init__(
+        self,
+        client,
+        target: str,
+        window_ms: float = 2.0,
+        max_batch: int = 256,
+        namespace_getter: Optional[Callable[[str], Optional[dict]]] = None,
+    ):
+        self.client = client
+        self.target = target
+        self.window = window_ms / 1000.0
+        self.max_batch = max_batch
+        self.namespace_getter = namespace_getter
+        self._pending: List[Tuple[Dict[str, Any], Future]] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.batches_dispatched = 0
+        self.requests_batched = 0
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def submit(self, request: Dict[str, Any]) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            self._pending.append((request, fut))
+            n = len(self._pending)
+        if n >= self.max_batch:
+            self._wake.set()
+        return fut
+
+    def _loop(self) -> None:
+        while not self._stop:
+            self._wake.wait(self.window)
+            self._wake.clear()
+            with self._lock:
+                batch = self._pending
+                self._pending = []
+            if not batch:
+                continue
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: List[Tuple[Dict[str, Any], Future]]) -> None:
+        reviews = []
+        for request, _ in batch:
+            ns_obj = None
+            namespace = request.get("namespace", "")
+            if namespace and self.namespace_getter is not None:
+                ns_obj = self.namespace_getter(namespace)
+            reviews.append(AugmentedReview(request, namespace=ns_obj))
+        try:
+            all_responses = self.client.review_many(reviews)
+        except Exception as e:
+            for _, fut in batch:
+                fut.set_exception(e)
+            return
+        self.batches_dispatched += 1
+        self.requests_batched += len(batch)
+        for (_, fut), responses in zip(batch, all_responses):
+            resp = responses.by_target.get(self.target)
+            fut.set_result(resp.results if resp is not None else [])
+
+
+class BatchedValidationHandler(ValidationHandler):
+    """ValidationHandler whose review path goes through the batcher."""
+
+    def __init__(self, batcher: MicroBatcher, **kwargs):
+        super().__init__(
+            batcher.client,
+            batcher.target,
+            namespace_getter=batcher.namespace_getter,
+            **kwargs,
+        )
+        self.batcher = batcher
+
+    def _review(self, request: Dict[str, Any]) -> List[Any]:
+        return self.batcher.submit(request).result(timeout=30)
+
+
+class WebhookServer:
+    """Stdlib HTTP server: POST /v1/admit and /v1/admitlabel with
+    AdmissionReview JSON bodies."""
+
+    def __init__(
+        self,
+        client,
+        target: str,
+        port: int = 0,
+        excluder=None,
+        namespace_getter=None,
+        exempt_namespaces=None,
+        window_ms: float = 2.0,
+        metrics=None,
+    ):
+        self.batcher = MicroBatcher(
+            client, target, window_ms=window_ms,
+            namespace_getter=namespace_getter,
+        )
+        self.handler = BatchedValidationHandler(
+            self.batcher, excluder=excluder, metrics=metrics
+        )
+        self.label_handler = NamespaceLabelHandler(exempt_namespaces)
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    review = json.loads(body)
+                    request = review.get("request") or {}
+                    if self.path == "/v1/admitlabel":
+                        resp = outer.label_handler.handle(request)
+                    else:
+                        resp = outer.handler.handle(request)
+                    out = {
+                        "apiVersion": review.get(
+                            "apiVersion", "admission.k8s.io/v1"
+                        ),
+                        "kind": "AdmissionReview",
+                        "response": resp.to_dict(uid=request.get("uid")),
+                    }
+                    payload = json.dumps(out).encode()
+                    self.send_response(200)
+                except Exception as e:
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):  # silence default stderr spam
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self.batcher.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self.batcher.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,343 @@
+"""Webhook tests: validation handler semantics (policy.go:141-408),
+namespace-label guard, micro-batching, and the HTTP shim."""
+
+import json
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from gatekeeper_tpu.constraint import (
+    AugmentedUnstructured,
+    Backend,
+    K8sValidationTarget,
+    RegoDriver,
+    TpuDriver,
+)
+from gatekeeper_tpu.control import Excluder
+from gatekeeper_tpu.metrics import MetricsRegistry
+from gatekeeper_tpu.webhook import (
+    IGNORE_LABEL,
+    NamespaceLabelHandler,
+    ValidationHandler,
+    WebhookServer,
+)
+from gatekeeper_tpu.webhook.policy import SERVICE_ACCOUNT
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+REQ_LABELS = """package reqlabels
+
+violation[{"msg": msg}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("missing: %v", [missing])
+}
+"""
+
+
+def template(kind, rego):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    }
+
+
+def constraint(kind, name, params=None, enforcement=None, match=None):
+    spec = {}
+    if params is not None:
+        spec["parameters"] = params
+    if enforcement is not None:
+        spec["enforcementAction"] = enforcement
+    if match is not None:
+        spec["match"] = match
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def admission_request(obj, operation="CREATE", name=None, namespace=None,
+                      old_object=None, username="alice", uid="u1"):
+    kind = obj.get("kind") if obj else "Pod"
+    group = ""
+    api_version = (obj or {}).get("apiVersion", "v1")
+    if "/" in api_version:
+        group, version = api_version.split("/", 1)
+    else:
+        version = api_version
+    req = {
+        "uid": uid,
+        "kind": {"group": group, "version": version, "kind": kind},
+        "operation": operation,
+        "userInfo": {"username": username},
+        "object": obj,
+    }
+    if name is not None:
+        req["name"] = name
+    if namespace is not None:
+        req["namespace"] = namespace
+    if old_object is not None:
+        req["oldObject"] = old_object
+    return req
+
+
+def pod(name="p", ns="default", labels=None):
+    meta = {"name": name, "namespace": ns}
+    if labels:
+        meta["labels"] = labels
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": meta,
+        "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+    }
+
+
+@pytest.fixture()
+def client():
+    cl = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    cl.add_template(template("ReqLabels", REQ_LABELS))
+    cl.add_constraint(
+        constraint("ReqLabels", "need-owner", params={"labels": ["owner"]})
+    )
+    cl.add_constraint(
+        constraint(
+            "ReqLabels",
+            "want-team",
+            params={"labels": ["team"]},
+            enforcement="dryrun",
+        )
+    )
+    return cl
+
+
+@pytest.fixture()
+def handler(client):
+    return ValidationHandler(client, TARGET, log_denies=True)
+
+
+def test_deny_and_dryrun(handler):
+    resp = handler.handle(admission_request(pod(labels={"app": "x"})))
+    assert not resp.allowed and resp.code == 403
+    # only the deny constraint denies; dryrun is logged but allows
+    assert "[denied by need-owner]" in resp.message
+    assert "want-team" not in resp.message
+    dryrun_logs = [
+        e for e in handler.denied_log if e["constraint_action"] == "dryrun"
+    ]
+    assert dryrun_logs
+
+
+def test_allow_compliant(handler):
+    resp = handler.handle(
+        admission_request(pod(labels={"owner": "me", "team": "t"}))
+    )
+    assert resp.allowed
+
+
+def test_gk_service_account_bypasses(handler):
+    resp = handler.handle(
+        admission_request(pod(), username=SERVICE_ACCOUNT)
+    )
+    assert resp.allowed
+    assert "self-manage" in resp.message
+
+
+def test_delete_reviews_old_object(handler):
+    bad_old = pod(labels={"app": "x"})
+    resp = handler.handle(
+        admission_request(None, operation="DELETE", old_object=bad_old)
+    )
+    assert not resp.allowed and resp.code == 403
+
+
+def test_delete_without_old_object_500(handler):
+    resp = handler.handle(admission_request(None, operation="DELETE"))
+    assert not resp.allowed and resp.code == 500
+
+
+def test_excluded_namespace_allowed(client):
+    excluder = Excluder()
+    excluder.add([
+        {"processes": ["webhook"], "excludedNamespaces": ["kube-system"]}
+    ])
+    h = ValidationHandler(client, TARGET, excluder=excluder)
+    resp = h.handle(
+        admission_request(pod(ns="kube-system"), namespace="kube-system")
+    )
+    assert resp.allowed
+    assert "ignored" in resp.message
+    # audit process exclusion does not leak into the webhook
+    assert not excluder.is_namespace_excluded("audit", "kube-system")
+
+
+def test_template_validation_422(handler):
+    bad = template("BadTempl", "package x\nviolation { true ")  # parse error
+    req = admission_request(bad)
+    req["kind"] = {
+        "group": "templates.gatekeeper.sh",
+        "version": "v1beta1",
+        "kind": "ConstraintTemplate",
+    }
+    resp = handler.handle(req)
+    assert not resp.allowed and resp.code == 422
+
+
+def test_constraint_validation(handler):
+    unknown = constraint("NoSuchKind", "c1")
+    req = admission_request(unknown)
+    req["kind"] = {
+        "group": "constraints.gatekeeper.sh",
+        "version": "v1beta1",
+        "kind": "NoSuchKind",
+    }
+    resp = handler.handle(req)
+    assert not resp.allowed and resp.code == 422
+
+    bad_action = constraint("ReqLabels", "c2", enforcement="explode")
+    req = admission_request(bad_action)
+    req["kind"] = {
+        "group": "constraints.gatekeeper.sh",
+        "version": "v1beta1",
+        "kind": "ReqLabels",
+    }
+    resp = handler.handle(req)
+    assert not resp.allowed and resp.code == 500
+
+
+def test_namespace_attach_for_nsselector(client):
+    client.add_constraint(
+        constraint(
+            "ReqLabels",
+            "prod-only",
+            params={"labels": ["compliance"]},
+            match={"namespaceSelector": {"matchLabels": {"env": "prod"}}},
+        )
+    )
+    namespaces = {
+        "prod": {"apiVersion": "v1", "kind": "Namespace",
+                 "metadata": {"name": "prod", "labels": {"env": "prod"}}},
+        "dev": {"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "dev", "labels": {"env": "dev"}}},
+    }
+    h = ValidationHandler(
+        client, TARGET, namespace_getter=namespaces.get
+    )
+    resp = h.handle(
+        admission_request(
+            pod(ns="prod", labels={"owner": "x", "team": "t"}),
+            namespace="prod",
+        )
+    )
+    assert not resp.allowed and "prod-only" in resp.message
+    resp = h.handle(
+        admission_request(
+            pod(ns="dev", labels={"owner": "x", "team": "t"}),
+            namespace="dev",
+        )
+    )
+    assert resp.allowed
+
+
+def test_metrics_recorded(client):
+    metrics = MetricsRegistry()
+    h = ValidationHandler(client, TARGET, metrics=metrics)
+    h.handle(admission_request(pod(labels={"owner": "o", "team": "t"})))
+    h.handle(admission_request(pod(labels={"app": "x"})))
+    snap = metrics.snapshot()
+    assert snap["counters"]['request_count{admission_status="allow"}'] == 1
+    assert snap["counters"]['request_count{admission_status="deny"}'] == 1
+
+
+def test_namespace_label_guard():
+    h = NamespaceLabelHandler(exempt_namespaces=["kube-system"])
+    ns = {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": "evil", "labels": {IGNORE_LABEL: "1"}},
+    }
+    resp = h.handle(admission_request(ns, name="evil"))
+    assert not resp.allowed and resp.code == 403
+    ns2 = {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": "kube-system", "labels": {IGNORE_LABEL: "1"}},
+    }
+    assert h.handle(admission_request(ns2, name="kube-system")).allowed
+    plain = {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": "ok"}}
+    assert h.handle(admission_request(plain, name="ok")).allowed
+
+
+def test_review_many_matches_serial(client):
+    objs = [
+        AugmentedUnstructured(pod(f"p{i}", labels={"owner": "o"} if i % 2 else None))
+        for i in range(8)
+    ]
+    batched = client.review_many(objs)
+    for obj, responses in zip(objs, batched):
+        serial = client.review(obj)
+        want = [
+            (r.msg, r.enforcement_action)
+            for r in serial.by_target[TARGET].results
+        ]
+        got = [
+            (r.msg, r.enforcement_action)
+            for r in responses.by_target[TARGET].results
+        ]
+        assert got == want
+
+
+def test_webhook_server_end_to_end(client):
+    server = WebhookServer(client, TARGET, window_ms=1.0)
+    server.start()
+    try:
+        def post(path, req):
+            body = json.dumps(
+                {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+                 "request": req}
+            ).encode()
+            r = urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{server.port}{path}",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=10,
+            )
+            return json.loads(r.read())
+
+        # concurrent requests coalesce into micro-batches
+        reqs = [
+            admission_request(
+                pod(f"p{i}", labels={"owner": "o"} if i % 2 else {"app": "x"}),
+                uid=f"uid{i}",
+            )
+            for i in range(16)
+        ]
+        with ThreadPoolExecutor(max_workers=16) as ex:
+            outs = list(ex.map(lambda r: post("/v1/admit", r), reqs))
+        for i, out in enumerate(outs):
+            assert out["response"]["uid"] == f"uid{i}"
+            assert out["response"]["allowed"] == bool(i % 2)
+        assert server.batcher.requests_batched == 16
+        assert server.batcher.batches_dispatched <= 16
+
+        # label endpoint
+        ns = {"apiVersion": "v1", "kind": "Namespace",
+              "metadata": {"name": "evil", "labels": {IGNORE_LABEL: "1"}}}
+        out = post("/v1/admitlabel", admission_request(ns, name="evil"))
+        assert out["response"]["allowed"] is False
+    finally:
+        server.stop()
