@@ -202,10 +202,15 @@ class AuditManager:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            t0 = time.monotonic()
             try:
                 self.audit()
                 self.last_error = None
             except Exception as e:  # sweep failures don't kill the loop
                 self.last_error = e
                 self.error_count += 1
-            self._stop.wait(self.audit_interval)
+            # fixed cadence like the reference's ticker (manager.go:
+            # 344-358): the next sweep starts `audit_interval` after the
+            # previous one STARTED, not after it finished
+            elapsed = time.monotonic() - t0
+            self._stop.wait(max(0.0, self.audit_interval - elapsed))

@@ -94,26 +94,50 @@ class MetricsRegistry:
             }
 
     @staticmethod
-    def _fmt(key: Tuple[str, Tuple]) -> str:
+    def _escape(v: str) -> str:
+        """Prometheus exposition label-value escaping: backslash, double
+        quote, and newline must be escaped or scrapers reject the page."""
+        return (
+            v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+        )
+
+    @classmethod
+    def _fmt(cls, key: Tuple[str, Tuple]) -> str:
         name, tags = key
         if not tags:
             return name
-        inner = ",".join(f'{k}="{v}"' for k, v in tags)
+        inner = ",".join(f'{k}="{cls._escape(v)}"' for k, v in tags)
         return f"{name}{{{inner}}}"
 
     def prometheus_text(self, prefix: str = "gatekeeper_") -> str:
         """Prometheus exposition format (prometheus_exporter.go's output
         namespace is "gatekeeper")."""
         lines = []
+        typed = set()
+
+        def _type(name: str, kind: str) -> None:
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {prefix}{name} {kind}")
+
         with self._lock:
             for (name, tags), v in sorted(self._counters.items()):
+                _type(name, "counter")
                 lines.append(f"{prefix}{self._fmt((name, tags))} {v}")
             for (name, tags), v in sorted(self._gauges.items()):
+                _type(name, "gauge")
                 lines.append(f"{prefix}{self._fmt((name, tags))} {v}")
             for (name, tags), d in sorted(self._dists.items()):
+                _type(name, "summary")
                 base = self._fmt((name, tags))
-                lines.append(f"{prefix}{base}_count {d.count}")
-                lines.append(f"{prefix}{base}_sum {d.total}")
+                if tags:
+                    stem, rest = base.split("{", 1)
+                    count_s = f"{stem}_count{{{rest}"
+                    sum_s = f"{stem}_sum{{{rest}"
+                else:
+                    count_s, sum_s = f"{base}_count", f"{base}_sum"
+                lines.append(f"{prefix}{count_s} {d.count}")
+                lines.append(f"{prefix}{sum_s} {d.total}")
         return "\n".join(lines) + "\n"
 
 

@@ -260,6 +260,14 @@ class FusedAuditKernel:
         larger cap. Stats count matched pairs on the compiled vs
         interpreter routes (valid rows only).
         """
+        n_pad = batch.tok_dev["spath"].shape[0]
+        if policy.c_pad * n_pad >= 2**31:
+            # the flat pair index is int32; over-scale populations must
+            # fail loudly, not silently corrupt pair decoding
+            raise OverflowError(
+                f"pair space c_pad({policy.c_pad}) x n_pad({n_pad}) "
+                f"overflows int32 flat indexing; shrink the chunk size"
+            )
         key = ("need", policy.key, batch.key, g, batch.n_valid, k_cap)
         entry = self._jit_cache.get(key)
         if entry is None:

@@ -70,26 +70,49 @@ class MicroBatcher:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        # in-flight requests must not hang until their caller timeout:
+        # dispatch whatever the worker left behind
+        with self._lock:
+            leftover = self._pending
+            self._pending = []
+        if leftover:
+            self._dispatch(leftover)
 
     def submit(self, request: Dict[str, Any]) -> Future:
         fut: Future = Future()
         with self._lock:
             self._pending.append((request, fut))
             n = len(self._pending)
-        if n >= self.max_batch:
+        if n == 1 or n >= self.max_batch:
             self._wake.set()
         return fut
 
     def _loop(self) -> None:
-        while not self._stop:
-            self._wake.wait(self.window)
+        while True:
+            # idle: block until the first request (or stop) arrives —
+            # no fixed-cadence wakeups while the queue is empty
+            self._wake.wait()
             self._wake.clear()
+            if self._stop:
+                return
+            # a batch has started forming: coalesce for up to `window`,
+            # cut short when max_batch fills
+            deadline = time.monotonic() + self.window
+            while not self._stop:
+                with self._lock:
+                    n = len(self._pending)
+                remaining = deadline - time.monotonic()
+                if n >= self.max_batch or remaining <= 0:
+                    break
+                self._wake.wait(remaining)
+                self._wake.clear()
             with self._lock:
                 batch = self._pending
                 self._pending = []
-            if not batch:
-                continue
-            self._dispatch(batch)
+            if batch:
+                self._dispatch(batch)
+            if self._stop:
+                return
 
     def _dispatch(self, batch: List[Tuple[Dict[str, Any], Future]]) -> None:
         reviews = []

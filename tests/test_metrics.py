@@ -1,0 +1,88 @@
+"""Metrics registry + Prometheus exposition tests.
+
+Reference counterpart: pkg/metrics/ (OpenCensus -> Prometheus exporter on
+:8888) and the per-subsystem stats_reporter tests
+(pkg/webhook/stats_reporter_test.go, pkg/audit/stats_reporter_test.go).
+"""
+
+import json
+import urllib.request
+
+from gatekeeper_tpu.metrics import MetricsRegistry, serve_metrics
+
+
+def test_counter_gauge_dist_roundtrip():
+    reg = MetricsRegistry()
+    reg.record("requests", 1, admission_status="allow")
+    reg.record("requests", 2, admission_status="allow")
+    reg.record("requests", 1, admission_status="deny")
+    reg.gauge("constraints", 5, enforcement_action="deny", status="active")
+    reg.observe("request_duration_seconds", 0.25)
+    reg.observe("request_duration_seconds", 0.75)
+    snap = reg.snapshot()
+    assert snap["counters"]['requests{admission_status="allow"}'] == 3
+    assert snap["counters"]['requests{admission_status="deny"}'] == 1
+    assert (
+        snap["gauges"]['constraints{enforcement_action="deny",status="active"}']
+        == 5
+    )
+    d = snap["distributions"]["request_duration_seconds"]
+    assert d["count"] == 2 and abs(d["sum"] - 1.0) < 1e-9
+    assert d["min"] == 0.25 and d["max"] == 0.75 and d["avg"] == 0.5
+
+
+def test_timed_context_manager():
+    reg = MetricsRegistry()
+    with reg.timed("op_seconds", kind="x"):
+        pass
+    d = reg.snapshot()["distributions"]['op_seconds{kind="x"}']
+    assert d["count"] == 1 and d["sum"] >= 0
+
+
+def test_prometheus_text_format_and_types():
+    reg = MetricsRegistry()
+    reg.record("requests", 3, admission_status="allow")
+    reg.gauge("constraints", 7)
+    reg.observe("request_duration_seconds", 0.5, purpose="webhook")
+    text = reg.prometheus_text()
+    assert "# TYPE gatekeeper_requests counter" in text
+    assert "# TYPE gatekeeper_constraints gauge" in text
+    assert "# TYPE gatekeeper_request_duration_seconds summary" in text
+    assert 'gatekeeper_requests{admission_status="allow"} 3' in text
+    assert "gatekeeper_constraints 7" in text
+    # _count/_sum suffixes attach to the metric NAME, before the braces
+    assert (
+        'gatekeeper_request_duration_seconds_count{purpose="webhook"} 1'
+        in text
+    )
+    assert (
+        'gatekeeper_request_duration_seconds_sum{purpose="webhook"} 0.5'
+        in text
+    )
+
+
+def test_prometheus_label_escaping():
+    """Label values containing quote/backslash/newline must be escaped
+    per the exposition format or scrapers reject the page."""
+    reg = MetricsRegistry()
+    reg.record("violations", 1, msg='say "hi"\\path\nnext')
+    text = reg.prometheus_text()
+    assert 'msg="say \\"hi\\"\\\\path\\nnext"' in text
+    # no raw newline may survive inside a sample line
+    for line in text.splitlines():
+        assert line.count('"') % 2 == 0
+
+
+def test_serve_metrics_http():
+    reg = MetricsRegistry()
+    reg.record("requests", 9)
+    httpd = serve_metrics(reg, port=0)
+    try:
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as r:
+            body = r.read().decode()
+        assert "gatekeeper_requests 9" in body
+    finally:
+        httpd.shutdown()
