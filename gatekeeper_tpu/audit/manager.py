@@ -110,6 +110,9 @@ class AuditManager:
         constraint_violations_limit: int = DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT,
         msg_size: int = DEFAULT_MSG_SIZE,
         now: Callable[[], float] = time.time,
+        metrics=None,
+        event_sink: Optional[Callable[[Dict[str, Any]], None]] = None,
+        emit_audit_events: bool = False,
     ):
         self.client = client
         self.target = target
@@ -118,6 +121,10 @@ class AuditManager:
         self.violations_limit = constraint_violations_limit
         self.msg_size = msg_size
         self._now = now
+        self.metrics = metrics
+        # violation event emission (--emit-audit-events, manager.go:684)
+        self.event_sink = event_sink
+        self.emit_audit_events = emit_audit_events
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last_run_seconds: Optional[float] = None
@@ -171,6 +178,25 @@ class AuditManager:
                         namespace=meta.get("namespace", ""),
                     )
                 )
+            if self.emit_audit_events and self.event_sink is not None:
+                res = r.resource if isinstance(r.resource, dict) else {}
+                meta = res.get("metadata") or {}
+                self.event_sink(
+                    {
+                        "type": "Warning",
+                        "reason": "AuditViolation",
+                        "process": "audit",
+                        "constraint_kind": ckind,
+                        "constraint_name": cname,
+                        "enforcement_action": ea,
+                        "resource_kind": res.get("kind", ""),
+                        "resource_namespace": meta.get("namespace", ""),
+                        "resource_name": meta.get("name", ""),
+                        "message": truncate_message(
+                            r.msg or "", self.msg_size
+                        ),
+                    }
+                )
 
         duration = self._now() - t0
         report = AuditReport(
@@ -183,6 +209,15 @@ class AuditManager:
         self.sink.publish(report)
         self.last_run_seconds = t0
         self.audit_duration_seconds = duration
+        if self.metrics is not None:
+            # the audit stats reporter's metric surface
+            # (pkg/audit/stats_reporter.go; docs/Metrics.md:83-104)
+            self.metrics.observe("audit_duration_seconds", duration)
+            self.metrics.gauge("audit_last_run_time", t0)
+            for ea, n in totals_by_ea.items():
+                self.metrics.gauge(
+                    "violations", n, enforcement_action=ea
+                )
         return report
 
     # -- sweep loop (auditManagerLoop, manager.go:344-358) -------------------

@@ -1,0 +1,351 @@
+"""State-ingestion controllers: the reconcile plane.
+
+Counterparts of pkg/controller/*: each controller consumes watch events
+for its GVKs and drives the constraint-framework Client, so no caller
+ever touches the Client directly — exactly the reference's ingestion
+architecture (SURVEY §3.4/§3.5 call stacks).
+
+  * `TemplateController` — ConstraintTemplate upsert/delete →
+    create_crd + add_template / remove_template, dynamic watch
+    registration for the constraint kind, readiness observe, per-pod
+    status publication, ingestion metrics
+    (constrainttemplate_controller.go:244,398-485,553).
+  * `ConstraintController` — one controller for ALL constraint kinds,
+    fed dynamically as templates create kinds (the reference packs
+    GVK+name into one shared channel, constraint_controller.go:138-189,
+    util/pack.go:16; here the Event carries its GVK natively) →
+    add_constraint / remove_constraint + status + metrics.
+  * `ConfigController` — the singleton Config (gatekeeper-system/config,
+    pkg/keys/config.go:24): rebuilds the process excluder, computes the
+    sync-only GVK set, wipes all cached data, and swaps the sync
+    registrar's watch set — the initial List the watch manager replays
+    through the pipe IS replayData (config_controller.go:183,268-331).
+  * `SyncController` — data GVK events → add_data / remove_data,
+    filtered against the live sync set so stale events from a replaced
+    watch are dropped (opadataclient.go FilteredDataClient), readiness
+    observe + sync metrics.
+
+Controllers process events inline on the watch manager's distribution
+thread (the reference's workqueue concurrency is 1 for config/sync too);
+`ControllerSwitch` drains reconciles on shutdown
+(watch/controller_switch.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Set, Tuple
+
+from ..constraint.target import WipeData
+from .events import DELETED, Event, GVK
+from .process import Excluder
+from .readiness import ReadinessTracker
+from .watch import Registrar, WatchManager
+
+TEMPLATE_GVK = GVK("templates.gatekeeper.sh", "v1beta1", "ConstraintTemplate")
+CONFIG_GVK = GVK("config.gatekeeper.sh", "v1alpha1", "Config")
+CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
+CONFIG_NAMESPACE = "gatekeeper-system"
+CONFIG_NAME = "config"
+
+
+def constraint_gvk(kind: str) -> GVK:
+    return GVK(CONSTRAINT_GROUP, "v1beta1", kind)
+
+
+class ControllerSwitch:
+    """Shutdown gate: reconciles become no-ops once stopped
+    (watch/controller_switch.go)."""
+
+    def __init__(self):
+        self._on = True
+        self._lock = threading.Lock()
+
+    def enter(self) -> bool:
+        with self._lock:
+            return self._on
+
+    def stop(self) -> None:
+        with self._lock:
+            self._on = False
+
+
+class TemplateController:
+    def __init__(
+        self,
+        client,
+        watch_mgr: WatchManager,
+        constraint_registrar: Registrar,
+        tracker: Optional[ReadinessTracker] = None,
+        switch: Optional[ControllerSwitch] = None,
+        metrics=None,
+        status=None,
+    ):
+        self.client = client
+        self.watch_mgr = watch_mgr
+        self.constraint_registrar = constraint_registrar
+        self.tracker = tracker
+        self.switch = switch
+        self.metrics = metrics
+        self.status = status
+        self._lock = threading.Lock()
+        self._kinds: Dict[str, str] = {}  # template name -> constraint kind
+        self.errors: Dict[str, str] = {}  # template name -> last error
+
+    def sink(self, ev: Event) -> None:
+        if self.switch is not None and not self.switch.enter():
+            return
+        meta = ev.obj.get("metadata") or {}
+        name = meta.get("name", "")
+        t0 = time.perf_counter()
+        status = "active"
+        try:
+            if ev.type == DELETED:
+                self._on_delete(name, ev.obj)
+            else:
+                self._on_upsert(name, ev.obj)
+            self.errors.pop(name, None)
+        except Exception as e:
+            status = "error"
+            self.errors[name] = str(e)
+        if self.metrics is not None:
+            self.metrics.observe(
+                "constraint_template_ingestion_duration_seconds",
+                time.perf_counter() - t0,
+                status=status,
+            )
+            self._report_count()
+        if self.status is not None:
+            self.status.publish_template(name, status, self.errors.get(name))
+        # readiness: observed whether or not compile succeeded — an
+        # erroring template must not hold the process unready forever
+        # (the reference tracker observes on reconcile, not success)
+        if self.tracker is not None:
+            self.tracker.templates.observe(name)
+
+    def _on_upsert(self, name: str, obj: dict) -> None:
+        crd = self.client.create_crd(obj)
+        self.client.add_template(obj)
+        with self._lock:
+            self._kinds[name] = crd.kind
+        # dynamic watch: constraints of this kind now flow to the
+        # constraint controller (constrainttemplate_controller.go:458)
+        self.constraint_registrar.add_watch(constraint_gvk(crd.kind))
+
+    def _on_delete(self, name: str, obj: dict) -> None:
+        with self._lock:
+            kind = self._kinds.pop(name, None)
+        if kind is not None:
+            self.constraint_registrar.remove_watch(constraint_gvk(kind))
+        self.client.remove_template(obj)
+        if self.tracker is not None:
+            self.tracker.templates.cancel_expect(name)
+        if self.status is not None:
+            self.status.delete_template(name)
+
+    def _report_count(self) -> None:
+        # active = ingested templates without a live error; error = every
+        # template whose last reconcile failed (ingested-before or not)
+        with self._lock:
+            ingested = set(self._kinds)
+        errs = set(self.errors)
+        self.metrics.gauge(
+            "constraint_templates", len(ingested - errs), status="active"
+        )
+        self.metrics.gauge("constraint_templates", len(errs), status="error")
+
+
+class ConstraintController:
+    def __init__(
+        self,
+        client,
+        tracker: Optional[ReadinessTracker] = None,
+        switch: Optional[ControllerSwitch] = None,
+        metrics=None,
+        status=None,
+    ):
+        self.client = client
+        self.tracker = tracker
+        self.switch = switch
+        self.metrics = metrics
+        self.status = status
+        self._lock = threading.Lock()
+        self._by_kind: Dict[str, Set[str]] = {}  # kind -> names
+        # "Kind/name" -> (enforcement_action, status) for metric series
+        self._series: Dict[str, Tuple[str, str]] = {}
+        self.errors: Dict[str, str] = {}  # "Kind/name" -> last error
+
+    def sink(self, ev: Event) -> None:
+        if self.switch is not None and not self.switch.enter():
+            return
+        kind = ev.gvk.kind
+        meta = ev.obj.get("metadata") or {}
+        name = meta.get("name", "")
+        key = f"{kind}/{name}"
+        ea = (
+            (ev.obj.get("spec") or {}).get("enforcementAction") or "deny"
+        )
+        status = "active"
+        try:
+            if ev.type == DELETED:
+                self.client.remove_constraint(ev.obj)
+                with self._lock:
+                    self._by_kind.get(kind, set()).discard(name)
+                    self._series.pop(key, None)
+                if self.tracker is not None:
+                    self.tracker.for_constraint_kind(kind).cancel_expect(name)
+                if self.status is not None:
+                    self.status.delete_constraint(kind, name)
+            else:
+                self.client.add_constraint(ev.obj)
+                with self._lock:
+                    self._by_kind.setdefault(kind, set()).add(name)
+            self.errors.pop(key, None)
+        except Exception as e:
+            status = "error"
+            self.errors[key] = str(e)
+        if ev.type != DELETED:
+            with self._lock:
+                self._series[key] = (ea, status)
+            if self.tracker is not None:
+                self.tracker.for_constraint_kind(kind).observe(name)
+            if self.status is not None:
+                self.status.publish_constraint(
+                    kind, name, status, ea, self.errors.get(key)
+                )
+        if self.metrics is not None:
+            # per-(enforcement_action, status) counts, with removed
+            # series reset to 0 so stale totals never linger
+            with self._lock:
+                counts: Dict[Tuple[str, str], int] = {}
+                for s_ea, s_st in self._series.values():
+                    counts[(s_ea, s_st)] = counts.get((s_ea, s_st), 0) + 1
+            for (s_ea, s_st) in {(ea, status), *counts}:
+                self.metrics.gauge(
+                    "constraints",
+                    counts.get((s_ea, s_st), 0),
+                    enforcement_action=s_ea,
+                    status=s_st,
+                )
+
+
+class SyncController:
+    def __init__(
+        self,
+        client,
+        tracker: Optional[ReadinessTracker] = None,
+        switch: Optional[ControllerSwitch] = None,
+        metrics=None,
+        excluder: Optional[Excluder] = None,
+    ):
+        self.client = client
+        self.tracker = tracker
+        self.switch = switch
+        self.metrics = metrics
+        self.excluder = excluder
+        self._lock = threading.Lock()
+        self._sync_set: Set[GVK] = set()
+
+    def set_sync_set(self, gvks: Set[GVK]) -> None:
+        with self._lock:
+            self._sync_set = set(gvks)
+
+    def sink(self, ev: Event) -> None:
+        if self.switch is not None and not self.switch.enter():
+            return
+        with self._lock:
+            if ev.gvk not in self._sync_set:
+                return  # FilteredDataClient: stale watch events dropped
+        meta = ev.obj.get("metadata") or {}
+        ns = meta.get("namespace") or ""
+        if (
+            ns
+            and self.excluder is not None
+            and self.excluder.is_namespace_excluded("sync", ns)
+        ):
+            return
+        t0 = time.perf_counter()
+        if ev.type == DELETED:
+            self.client.remove_data(ev.obj)
+            if self.tracker is not None:
+                # deleted-before-observed data must not wedge readiness
+                self.tracker.for_data(str(ev.gvk)).cancel_expect(
+                    (ns, meta.get("name") or "")
+                )
+        else:
+            self.client.add_data(ev.obj)
+            if self.tracker is not None:
+                self.tracker.for_data(str(ev.gvk)).observe(
+                    (ns, meta.get("name") or "")
+                )
+        if self.metrics is not None:
+            self.metrics.observe(
+                "sync_duration_seconds", time.perf_counter() - t0
+            )
+            self.metrics.record("sync", 1, kind=ev.gvk.kind)
+            self.metrics.gauge(
+                "sync_last_run_time", time.time(), kind=ev.gvk.kind
+            )
+
+
+class ConfigController:
+    """Singleton Config reconcile: excluder + sync set + wipe/replay
+    (config_controller.go:183-331)."""
+
+    def __init__(
+        self,
+        client,
+        sync_registrar: Registrar,
+        sync_controller: SyncController,
+        excluder: Excluder,
+        tracker: Optional[ReadinessTracker] = None,
+        switch: Optional[ControllerSwitch] = None,
+        metrics=None,
+    ):
+        self.client = client
+        self.sync_registrar = sync_registrar
+        self.sync_controller = sync_controller
+        self.excluder = excluder
+        self.tracker = tracker
+        self.switch = switch
+        self.metrics = metrics
+
+    def sink(self, ev: Event) -> None:
+        if self.switch is not None and not self.switch.enter():
+            return
+        meta = ev.obj.get("metadata") or {}
+        if (meta.get("namespace"), meta.get("name")) != (
+            CONFIG_NAMESPACE,
+            CONFIG_NAME,
+        ):
+            return  # only the keyed singleton is honored (keys/config.go)
+        spec = {} if ev.type == DELETED else (ev.obj.get("spec") or {})
+
+        # 1. process excluder from spec.match (excluder.go:43)
+        self.excluder.replace(spec.get("match") or [])
+
+        # 2. new sync-only set
+        sync_only: Set[GVK] = set()
+        for entry in ((spec.get("sync") or {}).get("syncOnly") or []):
+            sync_only.add(
+                GVK(
+                    entry.get("group", "") or "",
+                    entry.get("version", ""),
+                    entry.get("kind", ""),
+                )
+            )
+
+        # 3. wipe all cached data BEFORE the watch swap so replayed
+        # Lists rebuild from scratch (config_controller.go:268)
+        self.client.remove_data(WipeData())
+
+        # 4. swap watches; the initial List each new watch feeds through
+        # the distribution pipe is the replay (config_controller.go:294)
+        self.sync_controller.set_sync_set(sync_only)
+        self.sync_registrar.replace_watch(sync_only)
+
+        if self.tracker is not None:
+            self.tracker.config.observe((CONFIG_NAMESPACE, CONFIG_NAME))
+        if self.metrics is not None:
+            self.metrics.gauge("sync_gvk_count", len(sync_only))

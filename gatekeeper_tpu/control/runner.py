@@ -1,0 +1,325 @@
+"""The process runtime: boot a cluster-state-driven, Ready, serving
+gatekeeper-tpu instance.
+
+Counterpart of main.go (:103-308) + pkg/operations: wires the watch
+manager, the four ingestion controllers, the readiness tracker (with a
+real /readyz), the status plane, metrics, and the serving workloads
+(admission webhook + audit manager) — gated by `operations` roles the
+way `--operation` splits the reference deployment into webhook and
+audit pods (operations.go:15-19,77; deploy/gatekeeper.yaml).
+
+Nothing outside this module touches the Client directly: state flows
+cluster -> watch manager -> controllers -> Client, and the serving
+paths consume the Client — the reference's exact architecture
+(SURVEY §3 call stacks).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set
+
+from .controllers import (
+    CONFIG_GVK,
+    CONFIG_NAME,
+    CONFIG_NAMESPACE,
+    ConfigController,
+    ConstraintController,
+    ControllerSwitch,
+    SyncController,
+    TemplateController,
+    TEMPLATE_GVK,
+    constraint_gvk,
+)
+from .events import EventSource, FakeCluster, GVK
+from .process import Excluder
+from .readiness import ReadinessTracker
+from .status import (
+    CONSTRAINT_STATUS_GVK,
+    StatusAggregator,
+    StatusWriter,
+    TEMPLATE_STATUS_GVK,
+)
+from .watch import WatchManager
+
+OPERATION_WEBHOOK = "webhook"
+OPERATION_AUDIT = "audit"
+OPERATION_STATUS = "status"
+ALL_OPERATIONS = (OPERATION_WEBHOOK, OPERATION_AUDIT, OPERATION_STATUS)
+
+NAMESPACE_GVK = GVK("", "v1", "Namespace")
+
+
+class Runner:
+    def __init__(
+        self,
+        cluster: EventSource,
+        client,
+        target: str,
+        operations: Sequence[str] = ALL_OPERATIONS,
+        pod_name: str = "gatekeeper-pod",
+        metrics=None,
+        audit_interval: float = 60.0,
+        webhook_port: int = 0,
+        readyz_port: Optional[int] = 0,  # None disables the endpoint
+        exempt_namespaces: Sequence[str] = (),
+        webhook_tls: bool = False,
+    ):
+        self.cluster = cluster
+        self.client = client
+        self.target = target
+        self.operations = set(operations)
+        if metrics is None:
+            from ..metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self.excluder = Excluder()
+        self.tracker = ReadinessTracker()
+        self.switch = ControllerSwitch()
+        self.watch_mgr = WatchManager(cluster, metrics=metrics)
+        self.status_writer = (
+            StatusWriter(cluster, pod_name)
+            if OPERATION_STATUS in self.operations
+            else None
+        )
+        self.status_agg = StatusAggregator()
+        self.audit_interval = audit_interval
+        self.webhook_port = webhook_port
+        self.readyz_port = readyz_port
+        self.exempt_namespaces = list(exempt_namespaces)
+        self.webhook_tls = webhook_tls
+        self.webhook = None
+        self.audit = None
+        self._readyz_httpd: Optional[ThreadingHTTPServer] = None
+
+        # controllers (wired, not yet watching)
+        self.constraint_controller = ConstraintController(
+            client,
+            tracker=self.tracker,
+            switch=self.switch,
+            metrics=metrics,
+            status=self.status_writer,
+        )
+        self._constraint_registrar = self.watch_mgr.new_registrar(
+            "constraint-controller", self.constraint_controller.sink
+        )
+        self.template_controller = TemplateController(
+            client,
+            self.watch_mgr,
+            self._constraint_registrar,
+            tracker=self.tracker,
+            switch=self.switch,
+            metrics=metrics,
+            status=self.status_writer,
+        )
+        self._template_registrar = self.watch_mgr.new_registrar(
+            "template-controller", self.template_controller.sink
+        )
+        self.sync_controller = SyncController(
+            client,
+            tracker=self.tracker,
+            switch=self.switch,
+            metrics=metrics,
+            excluder=self.excluder,
+        )
+        self._sync_registrar = self.watch_mgr.new_registrar(
+            "sync-controller", self.sync_controller.sink
+        )
+        self.config_controller = ConfigController(
+            client,
+            self._sync_registrar,
+            self.sync_controller,
+            self.excluder,
+            tracker=self.tracker,
+            switch=self.switch,
+            metrics=metrics,
+        )
+        self._config_registrar = self.watch_mgr.new_registrar(
+            "config-controller", self.config_controller.sink
+        )
+        self._status_registrar = self.watch_mgr.new_registrar(
+            "status-controller", self.status_agg.sink
+        )
+
+    # -- boot ----------------------------------------------------------------
+
+    def _populate_expectations(self) -> None:
+        """Boot-time readiness barrier: list what exists NOW and expect
+        it to be ingested before reporting Ready
+        (ready_tracker.go:336-520)."""
+        templates = self.cluster.list(TEMPLATE_GVK)
+        for t in templates:
+            name = (t.get("metadata") or {}).get("name", "")
+            self.tracker.templates.expect(name)
+        self.tracker.templates.expectations_done()
+
+        for t in templates:
+            kind = (
+                ((((t.get("spec") or {}).get("crd") or {}).get("spec") or {})
+                 .get("names") or {})
+            ).get("kind") or ""
+            if not kind:
+                continue
+            tr = self.tracker.for_constraint_kind(kind)
+            for c in self.cluster.list(constraint_gvk(kind)):
+                tr.expect((c.get("metadata") or {}).get("name", ""))
+            tr.expectations_done()
+
+        configs = [
+            c
+            for c in self.cluster.list(CONFIG_GVK)
+            if ((c.get("metadata") or {}).get("namespace"),
+                (c.get("metadata") or {}).get("name"))
+            == (CONFIG_NAMESPACE, CONFIG_NAME)
+        ]
+        if configs:
+            self.tracker.config.expect((CONFIG_NAMESPACE, CONFIG_NAME))
+            spec = configs[0].get("spec") or {}
+            for entry in ((spec.get("sync") or {}).get("syncOnly") or []):
+                gvk = GVK(
+                    entry.get("group", "") or "",
+                    entry.get("version", ""),
+                    entry.get("kind", ""),
+                )
+                tr = self.tracker.for_data(str(gvk))
+                for obj in self.cluster.list(gvk):
+                    meta = obj.get("metadata") or {}
+                    tr.expect(
+                        (meta.get("namespace") or "", meta.get("name") or "")
+                    )
+                tr.expectations_done()
+        self.tracker.config.expectations_done()
+
+    def start(self) -> None:
+        self._populate_expectations()
+
+        # watch registration order mirrors setupControllers: templates
+        # first (they create constraint kinds), then config (it swaps the
+        # sync watches), status kinds for the aggregator
+        self._template_registrar.add_watch(TEMPLATE_GVK)
+        self._config_registrar.add_watch(CONFIG_GVK)
+        if OPERATION_STATUS in self.operations:
+            self._status_registrar.add_watch(TEMPLATE_STATUS_GVK)
+            self._status_registrar.add_watch(CONSTRAINT_STATUS_GVK)
+
+        if OPERATION_WEBHOOK in self.operations:
+            from ..webhook.server import WebhookServer
+
+            self.webhook = WebhookServer(
+                self.client,
+                self.target,
+                port=self.webhook_port,
+                excluder=self.excluder,
+                namespace_getter=self._get_namespace,
+                exempt_namespaces=self.exempt_namespaces,
+                metrics=self.metrics,
+                tls=self.webhook_tls,
+            )
+            self.webhook.start()
+
+        if OPERATION_AUDIT in self.operations:
+            from ..audit import AuditManager
+
+            self.audit = AuditManager(
+                self.client,
+                self.target,
+                audit_interval=self.audit_interval,
+                metrics=self.metrics,
+            )
+            self.audit.start()
+
+        if self.webhook is not None:
+            # warm the fused review path once ingestion settles so the
+            # first real admission request doesn't pay the jit compile
+            def _warm():
+                self.wait_ready(timeout=300)
+                self.webhook.warmup()
+
+            threading.Thread(target=_warm, daemon=True).start()
+
+        if self.readyz_port is not None:
+            self._serve_readyz()
+
+    def wait_ready(self, timeout: float = 30.0) -> bool:
+        """Block until ingestion satisfies the readiness barrier."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.watch_mgr.wait_idle(timeout=1.0)
+            if self.tracker.satisfied():
+                return True
+            time.sleep(0.01)
+        return self.tracker.satisfied()
+
+    def stop(self) -> None:
+        self.switch.stop()
+        if self.audit is not None:
+            self.audit.stop()
+        if self.webhook is not None:
+            self.webhook.stop()
+        if self._readyz_httpd is not None:
+            self._readyz_httpd.shutdown()
+        self.watch_mgr.stop()
+
+    # -- serving helpers -----------------------------------------------------
+
+    def _get_namespace(self, name: str) -> Optional[dict]:
+        return self.cluster.get(NAMESPACE_GVK, "", name)
+
+    def _serve_readyz(self) -> None:
+        runner = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path == "/readyz":
+                    ok = runner.tracker.satisfied()
+                    payload = json.dumps(
+                        {"ready": ok, "stats": runner.tracker.stats()}
+                    ).encode()
+                    self.send_response(200 if ok else 503)
+                elif self.path == "/healthz":
+                    payload = b'{"ok": true}'
+                    self.send_response(200)
+                else:
+                    payload = b"not found"
+                    self.send_response(404)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):
+                pass
+
+        self._readyz_httpd = ThreadingHTTPServer(
+            ("127.0.0.1", self.readyz_port or 0), _Handler
+        )
+        self.readyz_port = self._readyz_httpd.server_address[1]
+        threading.Thread(
+            target=self._readyz_httpd.serve_forever, daemon=True
+        ).start()
+
+
+def load_yaml_dir(cluster: FakeCluster, path: str) -> int:
+    """Bootstrap a FakeCluster from a directory tree of YAML manifests
+    (the slim standalone stand-in for a live apiserver; SURVEY §7 M5
+    allows exactly this for the benchmark configs)."""
+    import os
+
+    import yaml
+
+    n = 0
+    for root, _dirs, files in os.walk(path):
+        for fname in sorted(files):
+            if not fname.endswith((".yaml", ".yml")):
+                continue
+            with open(os.path.join(root, fname)) as f:
+                for doc in yaml.safe_load_all(f):
+                    if isinstance(doc, dict) and doc.get("kind"):
+                        cluster.apply(doc)
+                        n += 1
+    return n

@@ -35,7 +35,16 @@ class Expr:
 
 @dataclass
 class EvalCtx:
-    """Evaluation context: token columns, tables, per-constraint consts."""
+    """Evaluation context: token columns, tables, per-constraint consts.
+
+    `slabs`/`slab_cols`: optional pre-gathered fused-table slabs. A TPU
+    gather op costs ~10ms regardless of width, so the device path
+    gathers ALL pattern/table columns in a handful of fused ops
+    ([V, T] tables indexed by the token's spath/vid once, in the outer
+    trace, shared across every program group and vmap lane) and each
+    node slices its column out; without slabs, nodes fall back to
+    individual gathers (the numpy path, and ids shapes the slabs don't
+    cover)."""
 
     np: Any  # numpy-like module (jax.numpy under jit)
     tok: Dict[str, Any]  # spath/idx0/idx1/kind/vid/vnum, each [N, L]
@@ -46,6 +55,10 @@ class EvalCtx:
     g0: int = 8  # first-level array fanout
     g1: int = 8
     memo: Dict[int, Any] = field(default_factory=dict)
+    # slab name -> [N, L, T] pre-gathered fused table (device path only)
+    slabs: Optional[Dict[str, Any]] = None
+    # slab name -> {identifier: column index}
+    slab_cols: Optional[Dict[str, Dict[Any, int]]] = None
 
     @property
     def n(self) -> int:
@@ -195,6 +208,10 @@ class ESelPattern(Expr):
 
     def _emit(self, ctx):
         spath = ctx.tok["spath"]
+        if ctx.slabs is not None and "pat_member" in ctx.slabs:
+            col = ctx.slab_cols["pat_member"].get(self.pattern_idx)
+            if col is not None:
+                return (spath >= 0) & ctx.slabs["pat_member"][..., col]
         safe = ctx.np.maximum(spath, 0)
         return (spath >= 0) & ctx.pat_member[self.pattern_idx][safe]
 
@@ -210,6 +227,12 @@ class ECapture(Expr):
 
     def _emit(self, ctx):
         spath = ctx.tok["spath"]
+        if ctx.slabs is not None and "pat_capture" in ctx.slabs:
+            col = ctx.slab_cols["pat_capture"].get(self.pattern_idx)
+            if col is not None:
+                return ctx.np.where(
+                    spath >= 0, ctx.slabs["pat_capture"][..., col], -1
+                )
         safe = ctx.np.maximum(spath, 0)
         return ctx.np.where(
             spath >= 0, ctx.pat_capture[self.pattern_idx][safe], -1
@@ -228,6 +251,22 @@ class EStrTable(Expr):
         self.space = self.ids.space
 
     def _emit(self, ctx):
+        # tok-space vid lookups ride the fused pre-gathered slabs
+        if (
+            ctx.slabs is not None
+            and isinstance(self.ids, ETokCol)
+            and self.ids.col == "vid"
+        ):
+            for slab in ("vid_f32", "vid_bool", "vid_i32"):
+                if slab in ctx.slabs:
+                    col = ctx.slab_cols[slab].get(self.table)
+                    if col is not None:
+                        ids = ctx.tok["vid"]
+                        return ctx.np.where(
+                            ids >= 0,
+                            ctx.slabs[slab][..., col],
+                            self.default,
+                        )
         ids = self.ids.emit(ctx)
         tab = ctx.str_tables[self.table]
         safe = ctx.np.maximum(ids, 0)

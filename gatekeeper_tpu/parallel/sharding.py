@@ -71,11 +71,23 @@ def _pad_axis(a: np.ndarray, axis: int, mult: int, fill) -> np.ndarray:
 class StagedPolicy:
     """Constraint-side tensors resident on device (staged once per
     constraint-set change): match specs, grouped program consts, and the
-    compiled-constraint mask."""
+    compiled-constraint mask.
 
-    ms_dev: Dict[str, Any]
+    Both constraint-side inputs are content-deduplicated: `ms_dev` holds
+    only the U distinct match-spec rows (+1 match-nothing row for padded
+    constraints) with `spec_map` [C_pad] scattering them back, and each
+    program group's `stacked_consts` holds only its distinct const sets
+    with `group_cmaps` mapping constraint rows to them. Gatekeeper
+    populations are dedup-friendly by construction — constraints of one
+    template share spec.match blocks and parameter sets — so the O(C x N)
+    selector/program work collapses to O(U x N) + a row gather."""
+
+    ms_dev: Dict[str, Any]  # [U+1, ...] replicated
+    spec_map: Any  # [C_pad] int32 device, "c"-sharded
+    n_specs: int  # U (excluding the match-nothing row)
     group_exprs: List[Any]
     group_rows: List[List[int]]
+    group_cmaps: List[List[int]]  # per group: row -> unique-const index
     stacked_consts: List[Dict[str, Any]]
     compiled_mask: Any  # [C_pad] bool device
     prog_rows: List[int]
@@ -96,6 +108,24 @@ class StagedBatch:
     key: Tuple
 
 
+@dataclass
+class StackedCorpus:
+    """The whole corpus resident on device as [K, chunk, ...] stacked
+    tensors, so a full sweep is ONE device execution (a lax.map over the
+    chunk axis) and ONE host fetch. Per-chunk dispatches each pay a
+    ~70-100ms host<->device round trip on a tunneled chip; at 4+ chunks
+    per sweep that round-trip tax dominated the entire audit."""
+
+    fb_dev: Dict[str, Any]  # [K, chunk, ...]
+    tok_dev: Dict[str, Any]  # [K, chunk, ...]
+    row_fb: Any  # [K, chunk] bool device
+    n_valid: Any  # [K] int32 device (runtime occupancy per chunk)
+    n_valids: List[int]  # host copy
+    k: int
+    chunk: int
+    key: Tuple
+
+
 class FusedAuditKernel:
     """One-dispatch audit: [C, N] match ∧ per-program violation counts.
 
@@ -105,7 +135,8 @@ class FusedAuditKernel:
 
     Two dispatch forms:
       * `run`/`prepare` — full [C, N] outputs (dryrun/entry/tests);
-      * `stage_policy`/`stage_batch`/`dispatch_need` — device-resident
+      * `stage_policy`/`stage_corpus_stacked`/`dispatch_need_all` —
+        device-resident
         operands + sparse output: only the flat indices of pairs that
         need host-side interpreter work leave the device (the all-gather
         of violation indices the north star prescribes; gathering the
@@ -125,6 +156,7 @@ class FusedAuditKernel:
         # (group-set, shapes, n, g) specialization
         self._jit_cache: Dict[Tuple, List[Any]] = {}
         self._table_cache: Optional[Tuple[Tuple[int, int], Dict[str, Any]]] = None
+        self._fused_cols: Dict[str, Dict[Any, int]] = {}
 
     # -- shardings -----------------------------------------------------------
 
@@ -143,13 +175,50 @@ class FusedAuditKernel:
         self.tables.sync()
         gen = (self.patterns.generation, self.tables.generation)
         if self._table_cache is None or self._table_cache[0] != gen:
+            str_arrs = self.tables.arrays()
             arrs = {
                 "pat_member": self.patterns.member,
                 "pat_capture": self.patterns.capture,
-                **self.tables.arrays(),
+                **str_arrs,
             }
+            # fused transposed copies: a TPU gather op costs ~10ms
+            # regardless of width, so the sweep gathers every column in
+            # a handful of [V, T] row-gathers instead of one op per
+            # pattern/table (the transpose is host-side; device bool
+            # transposes are themselves ~100ms-class)
+            fused_cols: Dict[str, Dict[Any, int]] = {}
+            pm = np.asarray(self.patterns.member)
+            if pm.size:
+                arrs["pat_member!T"] = np.ascontiguousarray(pm.T)
+                fused_cols["pat_member"] = {
+                    i: i for i in range(pm.shape[0])
+                }
+                pc = np.asarray(self.patterns.capture)
+                arrs["pat_capture!T"] = np.ascontiguousarray(pc.T)
+                fused_cols["pat_capture"] = {
+                    i: i for i in range(pc.shape[0])
+                }
+            by_kind: Dict[str, List[Tuple[str, np.ndarray]]] = {}
+            for name, tab in str_arrs.items():
+                t = np.asarray(tab)
+                kind = (
+                    "vid_bool" if t.dtype == np.bool_
+                    else "vid_i32" if np.issubdtype(t.dtype, np.integer)
+                    else "vid_f32"
+                )
+                by_kind.setdefault(kind, []).append((name, t))
+            for kind, items in by_kind.items():
+                dt = {"vid_bool": np.bool_, "vid_i32": np.int32,
+                      "vid_f32": np.float32}[kind]
+                arrs[kind + "!T"] = np.ascontiguousarray(
+                    np.stack([t for _, t in items], axis=1).astype(dt)
+                )
+                fused_cols[kind] = {
+                    name: i for i, (name, _) in enumerate(items)
+                }
             # replicated policy-side tensors
             arrs = {k: self._put(v) for k, v in arrs.items()}
+            self._fused_cols = fused_cols
             self._table_cache = (gen, arrs)
         return self._table_cache[1]
 
@@ -162,11 +231,32 @@ class FusedAuditKernel:
     ) -> StagedPolicy:
         c = next(iter(ms.values())).shape[0]
         c_mult = self.mesh.shape["c"] if self.mesh else 1
-        ms_dev = {
-            k: self._put(_pad_axis(np.asarray(v), 0, c_mult, _ms_fill(k)), "c")
-            for k, v in ms.items()
-        }
-        c_pad = ms_dev["kind_rows"].shape[0]
+        c_pad = ((c + c_mult - 1) // c_mult) * c_mult
+
+        # content-dedup the match-spec rows: the selector kernel runs over
+        # the U distinct rows; a [C_pad] gather rebuilds the full matrix
+        ms_np = {k: np.asarray(v) for k, v in ms.items()}
+        uniq: Dict[bytes, int] = {}
+        reps: List[int] = []
+        spec_map = np.empty((c_pad,), np.int32)
+        ms_keys = sorted(ms_np)
+        for i in range(c):
+            sig = b"|".join(ms_np[k][i].tobytes() for k in ms_keys)
+            j = uniq.get(sig)
+            if j is None:
+                j = uniq[sig] = len(reps)
+                reps.append(i)
+            spec_map[i] = j
+        u = len(reps)
+        spec_map[c:] = u  # padded constraints -> the match-nothing row
+        rep_idx = np.asarray(reps, np.int64)
+        ms_dev = {}
+        for k, v in ms_np.items():
+            null_row = np.full((1,) + v.shape[1:], _ms_fill(k), v.dtype)
+            ms_dev[k] = self._put(
+                np.concatenate([v[rep_idx], null_row], axis=0)
+            )  # [U+1, ...] replicated — small after dedup
+
         compiled = [p for p in programs if p is not None]
         prog_rows = []
         row = 0
@@ -184,10 +274,22 @@ class FusedAuditKernel:
                 tuple(sorted((k, v.shape) for k, v in p.consts.items())),
             )
             grp = groups.setdefault(
-                gkey, {"expr": p.expr, "rows": [], "consts": []}
+                gkey,
+                {"expr": p.expr, "rows": [], "consts": [], "cmap": [],
+                 "cuniq": {}},
             )
             grp["rows"].append(ci)  # constraint-row index
-            grp["consts"].append(p.consts)
+            # dedup identical const sets within the group (constraints of
+            # one template frequently share parameters)
+            csig = b"|".join(
+                k.encode() + b"=" + np.asarray(p.consts[k]).tobytes()
+                for k in sorted(p.consts)
+            )
+            cj = grp["cuniq"].get(csig)
+            if cj is None:
+                cj = grp["cuniq"][csig] = len(grp["consts"])
+                grp["consts"].append(p.consts)
+            grp["cmap"].append(cj)
         group_list = list(groups.values())
         stacked_consts = [
             {
@@ -199,14 +301,19 @@ class FusedAuditKernel:
         key = (
             tuple(groups),
             tuple(tuple(grp["rows"]) for grp in group_list),
+            tuple(tuple(grp["cmap"]) for grp in group_list),
             c,
             c_pad,
+            u,
             id(self.mesh),
         )
         return StagedPolicy(
             ms_dev=ms_dev,
+            spec_map=self._put(spec_map, "c"),
+            n_specs=u,
             group_exprs=[grp["expr"] for grp in group_list],
             group_rows=[list(grp["rows"]) for grp in group_list],
+            group_cmaps=[list(grp["cmap"]) for grp in group_list],
             stacked_consts=stacked_consts,
             compiled_mask=self._put(compiled_mask, "c"),
             prog_rows=prog_rows,
@@ -215,138 +322,242 @@ class FusedAuditKernel:
             key=key,
         )
 
-    def stage_batch(
+    def stage_corpus_stacked(
         self,
-        fb: Dict[str, np.ndarray],
-        tok: Dict[str, np.ndarray],
-        row_fb: np.ndarray,
-        n_valid: int,
-    ) -> StagedBatch:
-        n_mult = self.mesh.shape["n"] if self.mesh else 1
+        chunks: Sequence[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray],
+                               np.ndarray, int]],
+    ) -> StackedCorpus:
+        """Stack per-chunk (fb, tok, row_fb, n_valid) onto a leading
+        chunk axis and ship to device once. All chunks must share the
+        padded chunk shape."""
+        k = len(chunks)
         fb_dev = {
-            k: self._put(_pad_axis(np.asarray(v), 0, n_mult, _fb_fill(k)), "n")
-            for k, v in fb.items()
+            key: self._put(
+                np.stack([c[0][key] for c in chunks]), None, "n"
+            )
+            for key in chunks[0][0]
         }
         tok_dev = {
-            k: self._put(
-                _pad_axis(np.asarray(v), 0, n_mult, 0.0 if k == "vnum" else -1),
-                "n",
+            key: self._put(
+                np.stack([c[1][key] for c in chunks]), None, "n"
             )
-            for k, v in tok.items()
+            for key in chunks[0][1]
         }
-        n_pad = tok_dev["spath"].shape[0]
-        rf = np.zeros((n_pad,), bool)
-        rf[: len(row_fb)] = row_fb
-        return StagedBatch(
+        chunk = tok_dev["spath"].shape[1]
+        row_fb = np.zeros((k, chunk), bool)
+        for i, c in enumerate(chunks):
+            row_fb[i, : len(c[2])] = c[2]
+        n_valids = [c[3] for c in chunks]
+        return StackedCorpus(
             fb_dev=fb_dev,
             tok_dev=tok_dev,
-            row_fb=self._put(rf, "n"),
-            n_valid=n_valid,
-            key=(tok_dev["spath"].shape, fb_dev["group_id"].shape, n_pad),
+            row_fb=self._put(row_fb, None, "n"),
+            n_valid=self._put(np.asarray(n_valids, np.int32)),
+            n_valids=n_valids,
+            k=k,
+            chunk=chunk,
+            key=(
+                k,
+                chunk,
+                tok_dev["spath"].shape,
+                fb_dev["group_id"].shape,
+            ),
         )
+
+    def dispatch_need_all(
+        self,
+        policy: StagedPolicy,
+        corpus: StackedCorpus,
+        g: int,
+        r_cap: int = 1024,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Whole-corpus sweep in ONE device execution + ONE fetch.
+
+        lax.map runs the per-chunk need computation (match x programs x
+        hot-row compaction — see dispatch_need) over the stacked chunk
+        axis; outputs come back stacked: packed [K, C_pad*R/8] uint8,
+        hot [K, R] int32, n_hot [K], compiled/interp pair stats [K].
+        Chunks whose n_hot exceeds r_cap are re-dispatched individually
+        by the caller (rare: violating rows are sparse in steady state).
+        """
+        r_cap = min(r_cap, corpus.chunk)
+        key = ("need_all", policy.key, corpus.key, g, r_cap)
+        entry = self._jit_cache.get(key)
+        if entry is None:
+            need_chunk = self._need_chunk_fn(policy, g, r_cap)
+
+            def run_all(ms_in, spec_map, fb_in, tok_in, tabs_in,
+                        consts_in, compiled_mask, row_fb, n_valid):
+                def body(xs):
+                    fb_c, tok_c, rf_c, nv_c = xs
+                    return need_chunk(
+                        ms_in, spec_map, fb_c, tok_c, tabs_in,
+                        consts_in, compiled_mask, rf_c, nv_c,
+                    )
+
+                return jax.lax.map(
+                    body, (fb_in, tok_in, row_fb, n_valid)
+                )
+
+            entry = [run_all, jax.jit(run_all)]
+            self._jit_cache[key] = entry
+        tabs = self._tables_device()
+        out = entry[1](
+            policy.ms_dev,
+            policy.spec_map,
+            corpus.fb_dev,
+            corpus.tok_dev,
+            tabs,
+            policy.stacked_consts,
+            policy.compiled_mask,
+            corpus.row_fb,
+            corpus.n_valid,
+        )
+        return jax.device_get(out)  # one transfer for the whole sweep
+
+    def _need_chunk_fn(self, policy: StagedPolicy, g: int, r_cap: int):
+        """The shared per-chunk need computation (trace-time closure
+        over the policy's program groups)."""
+        group_exprs = policy.group_exprs
+        group_rows = policy.group_rows
+        group_cmaps = policy.group_cmaps
+
+        def need_chunk(ms_in, spec_map, fb_in, tok_in, tabs_in,
+                       consts_in, compiled_mask, row_fb, n_valid):
+            from ..engine.exprs import EvalCtx
+
+            # [U+1, N] over distinct specs, gathered back to [C_pad, N]
+            match_u = match_matrix(ms_in, fb_in)
+            match = match_u[spec_map]
+            str_tabs = {
+                k: v
+                for k, v in tabs_in.items()
+                if k not in ("pat_member", "pat_capture")
+                and not k.endswith("!T")
+            }
+            # fused pre-gathers, ONCE per chunk in the outer trace and
+            # shared by every group and vmap lane (each expression node
+            # slices its column); XLA DCEs any slab no node touches
+            slabs = {}
+            if "pat_member!T" in tabs_in:
+                safe_sp = jnp.maximum(tok_in["spath"], 0)
+                slabs["pat_member"] = tabs_in["pat_member!T"][safe_sp]
+                slabs["pat_capture"] = tabs_in["pat_capture!T"][safe_sp]
+            safe_vid = jnp.maximum(tok_in["vid"], 0)
+            for kind in ("vid_bool", "vid_i32", "vid_f32"):
+                if kind + "!T" in tabs_in:
+                    slabs[kind] = tabs_in[kind + "!T"][safe_vid]
+            slab_cols = self._fused_cols
+            viol = jnp.zeros(match.shape, bool)
+            for expr, grows, cmap, consts_k in zip(
+                group_exprs, group_rows, group_cmaps, consts_in
+            ):
+
+                def eval_one(consts):
+                    ctx = EvalCtx(
+                        np=jnp,
+                        tok=tok_in,
+                        pat_member=tabs_in["pat_member"],
+                        pat_capture=tabs_in["pat_capture"],
+                        str_tables=str_tabs,
+                        consts=consts,
+                        g0=g,
+                        g1=g,
+                        slabs=slabs,
+                        slab_cols=slab_cols,
+                    )
+                    return expr.emit(ctx).astype(jnp.int32)
+
+                if consts_k:
+                    # [Ku, N] over distinct const sets, gathered out
+                    # to the group's constraint rows
+                    out_u = jax.vmap(eval_one)(consts_k) > 0
+                    out_k = out_u[jnp.asarray(cmap)]
+                else:
+                    one = eval_one({}) > 0
+                    out_k = jnp.broadcast_to(
+                        one, (len(grows),) + one.shape
+                    )
+                viol = viol.at[jnp.asarray(grows)].set(out_k)
+
+            valid_n = jnp.arange(match.shape[1]) < n_valid
+            fallback = (~compiled_mask[:, None]) | row_fb[None, :]
+            need = match & (viol | fallback) & valid_n[None, :]
+            stat_c = jnp.sum(
+                match & compiled_mask[:, None] & ~row_fb[None, :]
+                & valid_n[None, :]
+            )
+            stat_i = jnp.sum(match & fallback & valid_n[None, :])
+            # hot-row compaction: nonzero over [N] is cheap; the
+            # full-matrix nonzero/transpose is not
+            rowany = need.any(axis=0)  # [N]
+            n_hot = rowany.sum().astype(jnp.int32)
+            hot = jnp.nonzero(rowany, size=r_cap, fill_value=-1)[0]
+            sub = need[:, jnp.maximum(hot, 0)] & (hot >= 0)[None, :]
+            return (
+                jnp.packbits(sub.reshape(-1)),  # c-major over R cols
+                hot.astype(jnp.int32),
+                n_hot,
+                stat_c.astype(jnp.int32),
+                stat_i.astype(jnp.int32),
+            )
+
+        return need_chunk
 
     def dispatch_need(
         self,
         policy: StagedPolicy,
         batch: StagedBatch,
         g: int,
-        k_cap: int = 1 << 14,
-    ) -> Tuple[np.ndarray, int, int, int]:
-        """-> (flat pair indices [<=k_cap], n_need, compiled_pairs,
-        interp_pairs) for one staged chunk.
+        block: bool = True,
+        r_cap: int = 4096,
+    ) -> Tuple[Any, Any, Any, Any, Any]:
+        """-> (packed hot-row need bits [C_pad x R / 8] uint8 c-major,
+        hot row ids [R] int32, n_hot, compiled_pairs, interp_pairs) for
+        one staged chunk.
 
-        Flat index = n_local * c_pad + c (review-major). n_need may
-        exceed k_cap (truncated indices): callers re-dispatch with a
-        larger cap. Stats count matched pairs on the compiled vs
-        interpreter routes (valid rows only).
+        The need matrix is compacted on device to the rows that have any
+        needing pair (violating reviews are sparse in steady state):
+        a [N]-sized nonzero picks the hot rows, a gather extracts their
+        [C_pad, R] need columns, and only that bitmap leaves the device
+        (~C_pad*R/8 bytes — the full [C_pad, N] bitmap is a multi-MB
+        transfer and device-side full nonzero costs a ~150ms scatter
+        pass plus a ~400ms transpose per chunk on v5e). `n_hot` may
+        exceed r_cap: callers re-dispatch with a larger cap
+        (TpuDriver._need_pairs does). Stats count matched pairs on the
+        compiled vs interpreter routes (valid rows only).
+
+        With block=False the outputs come back as device arrays without
+        synchronizing — callers dispatch every chunk first, then resolve
+        with one device_get each, so chunk k+1's compute overlaps chunk
+        k's host decode. `n_valid` rides as a runtime scalar: any chunk
+        occupancy reuses one compiled program per (policy, shape-bucket,
+        r_cap).
         """
         n_pad = batch.tok_dev["spath"].shape[0]
-        if policy.c_pad * n_pad >= 2**31:
-            # the flat pair index is int32; over-scale populations must
-            # fail loudly, not silently corrupt pair decoding
-            raise OverflowError(
-                f"pair space c_pad({policy.c_pad}) x n_pad({n_pad}) "
-                f"overflows int32 flat indexing; shrink the chunk size"
-            )
-        key = ("need", policy.key, batch.key, g, batch.n_valid, k_cap)
+        r_cap = min(r_cap, n_pad)
+        key = ("need", policy.key, batch.key, g, r_cap)
         entry = self._jit_cache.get(key)
         if entry is None:
-            group_exprs = policy.group_exprs
-            group_rows = policy.group_rows
-            n_valid = batch.n_valid
-
-            def run_need(ms_in, fb_in, tok_in, tabs_in, consts_in,
-                         compiled_mask, row_fb):
-                from ..engine.exprs import EvalCtx
-
-                match = match_matrix(ms_in, fb_in)  # [C, N]
-                str_tabs = {
-                    k: v
-                    for k, v in tabs_in.items()
-                    if k not in ("pat_member", "pat_capture")
-                }
-                viol = jnp.zeros(match.shape, bool)
-                for expr, grows, consts_k in zip(
-                    group_exprs, group_rows, consts_in
-                ):
-
-                    def eval_one(consts):
-                        ctx = EvalCtx(
-                            np=jnp,
-                            tok=tok_in,
-                            pat_member=tabs_in["pat_member"],
-                            pat_capture=tabs_in["pat_capture"],
-                            str_tables=str_tabs,
-                            consts=consts,
-                            g0=g,
-                            g1=g,
-                        )
-                        return expr.emit(ctx).astype(jnp.int32)
-
-                    if consts_k:
-                        out_k = jax.vmap(eval_one)(consts_k) > 0
-                    else:
-                        one = eval_one({}) > 0
-                        out_k = jnp.broadcast_to(
-                            one, (len(grows),) + one.shape
-                        )
-                    viol = viol.at[jnp.asarray(grows)].set(out_k)
-
-                valid_n = jnp.arange(match.shape[1]) < n_valid
-                fallback = (~compiled_mask[:, None]) | row_fb[None, :]
-                need = match & (viol | fallback) & valid_n[None, :]
-                stat_c = jnp.sum(
-                    match & compiled_mask[:, None] & ~row_fb[None, :]
-                    & valid_n[None, :]
-                )
-                stat_i = jnp.sum(match & fallback & valid_n[None, :])
-                need_t = need.T.reshape(-1)  # review-major flat
-                idx = jnp.nonzero(need_t, size=k_cap, fill_value=-1)[0]
-                return (
-                    idx.astype(jnp.int32),
-                    need_t.sum().astype(jnp.int32),
-                    stat_c.astype(jnp.int32),
-                    stat_i.astype(jnp.int32),
-                )
-
+            run_need = self._need_chunk_fn(policy, g, r_cap)
             entry = [run_need, jax.jit(run_need)]
             self._jit_cache[key] = entry
         tabs = self._tables_device()
-        idx, n_need, stat_c, stat_i = entry[1](
+        out = entry[1](
             policy.ms_dev,
+            policy.spec_map,
             batch.fb_dev,
             batch.tok_dev,
             tabs,
             policy.stacked_consts,
             policy.compiled_mask,
             batch.row_fb,
+            jnp.int32(batch.n_valid),
         )
-        return (
-            np.asarray(idx),
-            int(n_need),
-            int(stat_c),
-            int(stat_i),
-        )
+        if not block:
+            return out
+        packed, hot, n_hot, stat_c, stat_i = jax.device_get(out)
+        return packed, hot, int(n_hot), int(stat_c), int(stat_i)
 
     # -- dispatch ------------------------------------------------------------
 
@@ -516,6 +727,20 @@ class FusedAuditKernel:
         counts = None if counts_p is None else np.asarray(counts_p)[:, :n]
         totals = np.asarray(totals_p)[:c]
         return match, counts, totals
+
+
+def decode_need(
+    packed: np.ndarray, hot: np.ndarray, c_pad: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Packed hot-row need bits -> (n_idx, c_idx) arrays sorted
+    review-major (matching the interpreter driver's emit order)."""
+    hot = np.asarray(hot)
+    r = hot.shape[0]
+    bits = np.unpackbits(np.asarray(packed))[: c_pad * r]
+    c_is, j_is = np.nonzero(bits.reshape(c_pad, r))
+    n_loc = hot[j_is]
+    order = np.lexsort((c_is, n_loc))
+    return n_loc[order], c_is[order]
 
 
 def _ms_fill(key: str):

@@ -1,0 +1,199 @@
+"""Webhook TLS: self-signed CA + server certificate with rotation.
+
+Behavioral mirror of pkg/webhook/certs.go:
+  * a self-signed CA valid 10 years (createCACert, certs.go:265-301)
+    signs a server certificate valid 1 year (createCertPEM,
+    certs.go:303-344);
+  * certificates are refreshed when missing, invalid, or within the
+    90-day rotation lookahead of expiry (refreshCertIfNeeded +
+    lookaheadInterval, certs.go:119-181,346);
+  * artifacts live in a directory as ca.crt / tls.crt / tls.key (the
+    reference stores them in a Secret mounted at certDir); serving
+    blocks until they exist (main.go:154-172's CertsMounted gate is the
+    `ensure()` call here).
+
+The CA bundle injection into a ValidatingWebhookConfiguration
+(certs.go:183-263) maps to `ca_bundle()` — the control plane hands it
+to whatever registers the webhook.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import threading
+from typing import Optional, Tuple
+
+CA_VALIDITY_DAYS = 3650  # 10 years (certs.go:269)
+CERT_VALIDITY_DAYS = 365  # 1 year (certs.go:307)
+LOOKAHEAD_DAYS = 90  # rotation lookahead (certs.go:346)
+
+CA_NAME = "gatekeeper-ca"
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+class CertRotator:
+    """Generates and rotates the CA + server cert pair on disk."""
+
+    def __init__(
+        self,
+        cert_dir: str,
+        dns_name: str = "localhost",
+        now=None,
+    ):
+        self.cert_dir = cert_dir
+        self.dns_name = dns_name
+        self._now = now if now is not None else _now
+        self._lock = threading.Lock()
+        self.rotations = 0
+
+    # -- paths ---------------------------------------------------------------
+
+    @property
+    def ca_path(self) -> str:
+        return os.path.join(self.cert_dir, "ca.crt")
+
+    @property
+    def cert_path(self) -> str:
+        return os.path.join(self.cert_dir, "tls.crt")
+
+    @property
+    def key_path(self) -> str:
+        return os.path.join(self.cert_dir, "tls.key")
+
+    # -- public --------------------------------------------------------------
+
+    def ensure(self) -> Tuple[str, str]:
+        """Refresh-if-needed; returns (cert_path, key_path). The serving
+        layer calls this before binding TLS (the CertsMounted gate)."""
+        with self._lock:
+            if self._needs_refresh():
+                self._refresh()
+        return self.cert_path, self.key_path
+
+    def ca_bundle(self) -> bytes:
+        self.ensure()
+        with open(self.ca_path, "rb") as f:
+            return f.read()
+
+    # -- internals -----------------------------------------------------------
+
+    def _needs_refresh(self) -> bool:
+        for p in (self.ca_path, self.cert_path, self.key_path):
+            if not os.path.exists(p):
+                return True
+        exp = self._cert_expiry(self.cert_path)
+        if exp is None:
+            return True
+        lookahead = self._now() + datetime.timedelta(days=LOOKAHEAD_DAYS)
+        return exp <= lookahead
+
+    @staticmethod
+    def _cert_expiry(path: str) -> Optional[datetime.datetime]:
+        from cryptography import x509
+
+        try:
+            with open(path, "rb") as f:
+                cert = x509.load_pem_x509_certificate(f.read())
+            return cert.not_valid_after_utc
+        except Exception:
+            return None
+
+    def _refresh(self) -> None:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+
+        os.makedirs(self.cert_dir, exist_ok=True)
+        now = self._now()
+
+        # CA (certs.go:265-301)
+        ca_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        ca_name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, CA_NAME)]
+        )
+        ca_cert = (
+            x509.CertificateBuilder()
+            .subject_name(ca_name)
+            .issuer_name(ca_name)
+            .public_key(ca_key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=CA_VALIDITY_DAYS))
+            .add_extension(
+                x509.BasicConstraints(ca=True, path_length=None),
+                critical=True,
+            )
+            .add_extension(
+                x509.KeyUsage(
+                    digital_signature=True,
+                    key_cert_sign=True,
+                    crl_sign=True,
+                    content_commitment=False,
+                    key_encipherment=False,
+                    data_encipherment=False,
+                    key_agreement=False,
+                    encipher_only=False,
+                    decipher_only=False,
+                ),
+                critical=True,
+            )
+            .sign(ca_key, hashes.SHA256())
+        )
+
+        # server cert (certs.go:303-344)
+        srv_key = rsa.generate_private_key(
+            public_exponent=65537, key_size=2048
+        )
+        sans = [x509.DNSName(self.dns_name)]
+        if self.dns_name != "localhost":
+            sans.append(x509.DNSName("localhost"))
+        sans.append(x509.IPAddress(ipaddress.ip_address("127.0.0.1")))
+        srv_cert = (
+            x509.CertificateBuilder()
+            .subject_name(
+                x509.Name(
+                    [x509.NameAttribute(NameOID.COMMON_NAME, self.dns_name)]
+                )
+            )
+            .issuer_name(ca_name)
+            .public_key(srv_key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(
+                now + datetime.timedelta(days=CERT_VALIDITY_DAYS)
+            )
+            .add_extension(
+                x509.SubjectAlternativeName(sans), critical=False
+            )
+            .add_extension(
+                x509.ExtendedKeyUsage(
+                    [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH]
+                ),
+                critical=False,
+            )
+            .sign(ca_key, hashes.SHA256())
+        )
+
+        pem = serialization.Encoding.PEM
+        with open(self.ca_path, "wb") as f:
+            f.write(ca_cert.public_bytes(pem))
+        with open(self.cert_path, "wb") as f:
+            f.write(srv_cert.public_bytes(pem))
+            # chain the CA so clients can verify with just tls.crt
+            f.write(ca_cert.public_bytes(pem))
+        with open(self.key_path, "wb") as f:
+            f.write(
+                srv_key.private_bytes(
+                    pem,
+                    serialization.PrivateFormat.TraditionalOpenSSL,
+                    serialization.NoEncryption(),
+                )
+            )
+        os.chmod(self.key_path, 0o600)
+        self.rotations += 1

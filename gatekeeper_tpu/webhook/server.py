@@ -7,11 +7,16 @@ requests are coalesced for up to `window_ms` (or until `max_batch`) and
 the whole batch is evaluated in ONE fused device dispatch via
 `Client.review_many` (SURVEY §2.4 row 3's micro-batching bridge).
 
-`WebhookServer` is a stdlib HTTP shim serving /v1/admit and
+`WebhookServer` is a stdlib HTTP server serving /v1/admit and
 /v1/admitlabel with AdmissionReview JSON — the in-process stand-in for
-the Go webhook pod; a production deployment would terminate TLS in front
-(the reference's cert rotation lives in its Go control plane,
-pkg/webhook/certs.go).
+the Go webhook pod. With `tls=True` it terminates HTTPS with a
+rotating self-signed CA + server cert (`certs.CertRotator`, the
+pkg/webhook/certs.go counterpart).
+
+Failure semantics preserve the reference's fail-open design (SURVEY §5):
+a failed fused batch falls back to per-request CPU-path evaluation, and
+only a request whose own fallback also fails gets an error response —
+one poisoned request can no longer 500 a whole batch.
 """
 
 from __future__ import annotations
@@ -26,6 +31,32 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..constraint import AugmentedReview
 from .namespacelabel import NamespaceLabelHandler
 from .policy import AdmissionResponse, ValidationHandler
+
+# the K8s webhook timeoutSeconds ceiling is 30s and Gatekeeper deploys
+# with 3s; our per-request deadline stays safely under the ceiling
+DEFAULT_REQUEST_TIMEOUT = 10.0
+
+
+def _warm_pod(n_labels: int) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "warmup",
+            "namespace": "default",
+            "labels": {f"k{i}": f"v{i}" for i in range(n_labels)},
+        },
+        "spec": {
+            "containers": [
+                {
+                    "name": "main",
+                    "image": "warmup.invalid/img",
+                    "resources": {"limits": {"cpu": "1", "memory": "1Gi"}},
+                    "securityContext": {"privileged": False},
+                }
+            ]
+        },
+    }
 
 
 class MicroBatcher:
@@ -57,6 +88,7 @@ class MicroBatcher:
         self._thread: Optional[threading.Thread] = None
         self.batches_dispatched = 0
         self.requests_batched = 0
+        self.batch_failures = 0
 
     def start(self) -> None:
         if self._thread is None:
@@ -124,9 +156,21 @@ class MicroBatcher:
             reviews.append(AugmentedReview(request, namespace=ns_obj))
         try:
             all_responses = self.client.review_many(reviews)
-        except Exception as e:
-            for _, fut in batch:
-                fut.set_exception(e)
+        except Exception:
+            # fused-path failure: fall back PER REQUEST to the serial
+            # review path so one poisoned request (or a device fault)
+            # cannot fail the whole batch — requests still get correct
+            # answers and only their own failure surfaces to them
+            self.batch_failures += 1
+            for review, (_, fut) in zip(reviews, batch):
+                try:
+                    responses = self.client.review(review)
+                    resp = responses.by_target.get(self.target)
+                    fut.set_result(
+                        resp.results if resp is not None else []
+                    )
+                except Exception as e:
+                    fut.set_exception(e)
             return
         self.batches_dispatched += 1
         self.requests_batched += len(batch)
@@ -138,7 +182,12 @@ class MicroBatcher:
 class BatchedValidationHandler(ValidationHandler):
     """ValidationHandler whose review path goes through the batcher."""
 
-    def __init__(self, batcher: MicroBatcher, **kwargs):
+    def __init__(
+        self,
+        batcher: MicroBatcher,
+        request_timeout: float = DEFAULT_REQUEST_TIMEOUT,
+        **kwargs,
+    ):
         super().__init__(
             batcher.client,
             batcher.target,
@@ -146,14 +195,19 @@ class BatchedValidationHandler(ValidationHandler):
             **kwargs,
         )
         self.batcher = batcher
+        self.request_timeout = request_timeout
 
     def _review(self, request: Dict[str, Any]) -> List[Any]:
-        return self.batcher.submit(request).result(timeout=30)
+        return self.batcher.submit(request).result(
+            timeout=self.request_timeout
+        )
 
 
 class WebhookServer:
-    """Stdlib HTTP server: POST /v1/admit and /v1/admitlabel with
-    AdmissionReview JSON bodies."""
+    """Stdlib HTTP(S) server: POST /v1/admit and /v1/admitlabel with
+    AdmissionReview JSON bodies. `tls=True` terminates HTTPS with the
+    rotating self-signed pair from `certs.CertRotator` (cert_dir
+    defaults to a per-server temp dir)."""
 
     def __init__(
         self,
@@ -165,13 +219,17 @@ class WebhookServer:
         exempt_namespaces=None,
         window_ms: float = 2.0,
         metrics=None,
+        tls: bool = False,
+        cert_dir: Optional[str] = None,
+        request_timeout: float = DEFAULT_REQUEST_TIMEOUT,
     ):
         self.batcher = MicroBatcher(
             client, target, window_ms=window_ms,
             namespace_getter=namespace_getter,
         )
         self.handler = BatchedValidationHandler(
-            self.batcher, excluder=excluder, metrics=metrics
+            self.batcher, excluder=excluder, metrics=metrics,
+            request_timeout=request_timeout,
         )
         self.label_handler = NamespaceLabelHandler(exempt_namespaces)
         outer = self
@@ -208,8 +266,26 @@ class WebhookServer:
                 pass
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self.rotator = None
+        if tls:
+            import ssl
+            import tempfile
+
+            from .certs import CertRotator
+
+            if cert_dir is None:
+                cert_dir = tempfile.mkdtemp(prefix="gk-certs-")
+            self.rotator = CertRotator(cert_dir)
+            cert_path, key_path = self.rotator.ensure()  # CertsMounted gate
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert_path, key_path)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
+        self.scheme = "https" if tls else "http"
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self.warm = False
 
     def start(self) -> None:
         self.batcher.start()
@@ -217,6 +293,45 @@ class WebhookServer:
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+
+    def warmup(self, sample_objects=None) -> float:
+        """Pre-compile the fused review path for common batch shapes so
+        the first real admission request doesn't pay the jit compile
+        inside its deadline (first compile is tens of seconds on TPU;
+        the reference has no analog — its interpreter has no compile
+        step, but it DOES gate Ready on state ingestion; compile warmth
+        is this engine's equivalent). Returns seconds spent."""
+        t0 = time.monotonic()
+        if sample_objects is None:
+            sample_objects = [_warm_pod(1), _warm_pod(8)]
+        reviews = []
+        for i, obj in enumerate(sample_objects):
+            reviews.append(
+                AugmentedReview(
+                    {
+                        "uid": f"warmup-{i}",
+                        "kind": {
+                            "group": "",
+                            "version": "v1",
+                            "kind": obj.get("kind", "Pod"),
+                        },
+                        "operation": "CREATE",
+                        "name": f"warmup-{i}",
+                        "namespace": "default",
+                        "userInfo": {"username": "system:warmup"},
+                        "object": obj,
+                    }
+                )
+            )
+        try:
+            # one single-review batch and one multi-review batch cover
+            # the common occupancy buckets (rows bucket at 64)
+            self.client.review_many(reviews[:1])
+            self.client.review_many(reviews)
+        except Exception:
+            pass  # warmup is best-effort; serving still works unwarmed
+        self.warm = True
+        return time.monotonic() - t0
 
     def stop(self) -> None:
         self._httpd.shutdown()

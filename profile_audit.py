@@ -1,0 +1,61 @@
+"""Break down client.audit() steady-state time with the stacked design."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench import TARGET, build_client
+
+
+def main():
+    n_res = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    n_con = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+    import jax
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    print(f"devices: {jax.devices()}")
+    drv = TpuDriver()
+    t0 = time.perf_counter()
+    client = build_client(drv, n_res, n_con)
+    print(f"ingest: {time.perf_counter()-t0:.1f}s")
+
+    t0 = time.perf_counter()
+    results = client.audit().by_target[TARGET].results
+    print(f"first sweep: {time.perf_counter()-t0:.1f}s, {len(results)} viols")
+
+    for trial in range(2):
+        with drv._mutex:
+            corpus = drv._audit_corpus(TARGET)
+            cs = drv._constraint_set(TARGET)
+            t0 = time.perf_counter()
+            pairs, sc, si = drv._need_pairs(cs, corpus)
+            t_need = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            inventory = drv._inventory(TARGET)
+            cache = drv._render_cache[TARGET][1]
+            hits = sum((p in cache) for p in pairs)
+            out = []
+            for n_i, c_i in pairs:
+                r = cache.get((n_i, c_i))
+                if r is None:
+                    r = drv._eval_template(
+                        TARGET, cs.constraints[c_i], corpus.reviews[n_i],
+                        inventory, None)
+                out.append(r)
+            t_render = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        results = client.audit().by_target[TARGET].results
+        t_full = time.perf_counter() - t0
+        print(f"trial {trial}: need={t_need:.3f}s render={t_render:.3f}s "
+              f"(cache hits {hits}/{len(pairs)}) full_audit={t_full:.3f}s")
+
+    # second process would hit the persistent compile cache; report dir
+    cc = jax.config.jax_compilation_cache_dir
+    print(f"compilation_cache_dir={cc}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""Real device-time bisect: every variant ends in device_get of a tiny
+scalar so transfer is constant and only compute differs."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bench import TARGET, build_client
+from gatekeeper_tpu.engine.matchkernel import match_matrix
+
+
+def timed(label, fn, make_args, n=4):
+    jax.device_get(fn(*make_args(0)))
+    ts = []
+    for i in range(1, n + 1):
+        t0 = time.perf_counter()
+        jax.device_get(fn(*make_args(i)))
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: min={min(ts)*1e3:.1f}ms")
+    return min(ts)
+
+
+def main():
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    drv = TpuDriver()
+    client = build_client(drv, 32768, 500)
+    with drv._mutex:
+        corpus = drv._audit_corpus(TARGET)
+        cs = drv._constraint_set(TARGET)
+        drv.patterns.sync()
+        drv.tables.sync()
+        policy = drv.kernel.stage_policy(cs.programs, cs.ms)
+        stacked = drv._stage_corpus(corpus)
+    g = corpus.g
+    tabs = drv.kernel._tables_device()
+    fb = {k: v[0] for k, v in stacked.fb_dev.items()}
+    tok = {k: v[0] for k, v in stacked.tok_dev.items()}
+    rf = stacked.row_fb[0]
+    n_pad = stacked.chunk
+
+    group_exprs = policy.group_exprs
+    group_rows = policy.group_rows
+    group_cmaps = policy.group_cmaps
+
+    def programs_viol(tok_in, tabs_in, consts_in, shape):
+        from gatekeeper_tpu.engine.exprs import EvalCtx
+
+        str_tabs = {
+            k: v for k, v in tabs_in.items()
+            if k not in ("pat_member", "pat_capture")
+        }
+        viol = jnp.zeros(shape, bool)
+        for expr, grows, cmap, consts_k in zip(
+            group_exprs, group_rows, group_cmaps, consts_in
+        ):
+            def eval_one(consts):
+                ctx = EvalCtx(
+                    np=jnp, tok=tok_in,
+                    pat_member=tabs_in["pat_member"],
+                    pat_capture=tabs_in["pat_capture"],
+                    str_tables=str_tabs, consts=consts, g0=g, g1=g,
+                )
+                return expr.emit(ctx).astype(jnp.int32)
+            if consts_k:
+                out_u = jax.vmap(eval_one)(consts_k) > 0
+                out_k = out_u[jnp.asarray(cmap)]
+            else:
+                one = eval_one({}) > 0
+                out_k = jnp.broadcast_to(one, (len(grows),) + one.shape)
+            viol = viol.at[jnp.asarray(grows)].set(out_k)
+        return viol
+
+    base = (policy.ms_dev, policy.spec_map, fb, tok, tabs,
+            policy.stacked_consts, policy.compiled_mask, rf)
+
+    t0v = timed("V0 scalar passthrough",
+                jax.jit(lambda nv: nv + 1),
+                lambda i: (jnp.int32(i),))
+
+    timed("V1 match+sum", jax.jit(
+        lambda ms_in, sm, fb_in, nv: match_matrix(ms_in, fb_in)[sm].sum() + nv),
+        lambda i: (policy.ms_dev, policy.spec_map, fb, jnp.int32(i)))
+
+    timed("V2 programs+sum", jax.jit(
+        lambda tok_in, tabs_in, consts_in, nv:
+        programs_viol(tok_in, tabs_in, consts_in,
+                      (policy.c_pad, n_pad)).sum() + nv),
+        lambda i: (tok, tabs, policy.stacked_consts, jnp.int32(i)))
+
+    def V3(ms_in, sm, fb_in, tok_in, tabs_in, consts_in, cm, rfx, nv):
+        match = match_matrix(ms_in, fb_in)[sm]
+        viol = programs_viol(tok_in, tabs_in, consts_in, match.shape)
+        valid_n = jnp.arange(match.shape[1]) < nv
+        fallback = (~cm[:, None]) | rfx[None, :]
+        need = match & (viol | fallback) & valid_n[None, :]
+        return need.sum()
+
+    timed("V3 need+sum", jax.jit(V3), lambda i: base + (jnp.int32(32768 - i),))
+
+    def V4(ms_in, sm, fb_in, tok_in, tabs_in, consts_in, cm, rfx, nv):
+        match = match_matrix(ms_in, fb_in)[sm]
+        viol = programs_viol(tok_in, tabs_in, consts_in, match.shape)
+        valid_n = jnp.arange(match.shape[1]) < nv
+        fallback = (~cm[:, None]) | rfx[None, :]
+        need = match & (viol | fallback) & valid_n[None, :]
+        rowany = need.any(axis=0)
+        hot = jnp.nonzero(rowany, size=1024, fill_value=-1)[0]
+        sub = need[:, jnp.maximum(hot, 0)] & (hot >= 0)[None, :]
+        return jnp.packbits(sub.reshape(-1)).sum() + rowany.sum()
+
+    timed("V4 full+compact (scalar out)", jax.jit(V4),
+          lambda i: base + (jnp.int32(32768 - i),))
+
+    # the real thing: the kernel's cached per-chunk fn, full outputs
+    def call(i):
+        from dataclasses import replace
+        b = drv.kernel
+        fn = b._jit_cache[[k for k in b._jit_cache if k[0] == "need_all"][0]][1]
+        return fn(policy.ms_dev, policy.spec_map, stacked.fb_dev,
+                  stacked.tok_dev, tabs, policy.stacked_consts,
+                  policy.compiled_mask, stacked.row_fb,
+                  jnp.asarray([32768 - i], jnp.int32))
+    # (stacked here is K=1 for 32768 corpus)
+    ts = []
+    jax.device_get(call(0))
+    for i in range(1, 4):
+        t0 = time.perf_counter()
+        jax.device_get(call(i))
+        ts.append(time.perf_counter() - t0)
+    print(f"V5 kernel need_all K={stacked.k}: min={min(ts)*1e3:.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Time the full client.audit() steady-state sweep (the bench metric)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench import TARGET, build_client
+
+
+def main():
+    n_resources = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    n_constraints = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+
+    import jax
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    print(f"devices: {jax.devices()}")
+    drv = TpuDriver()
+    t0 = time.perf_counter()
+    client = build_client(drv, n_resources, n_constraints)
+    print(f"ingest: {time.perf_counter()-t0:.1f}s")
+
+    t0 = time.perf_counter()
+    results = client.audit().by_target[TARGET].results
+    print(f"first sweep: {time.perf_counter()-t0:.1f}s, "
+          f"{len(results)} viols, stats={drv.stats}")
+
+    for i in range(4):
+        t0 = time.perf_counter()
+        results = client.audit().by_target[TARGET].results
+        print(f"sweep {i}: {time.perf_counter()-t0:.3f}s "
+              f"({len(results)} viols)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,416 @@
+"""State-ingestion plane tests: watch manager semantics, the four
+reconcilers, readiness, status aggregation, operations gating, and
+boot-from-manifests churn scenarios.
+
+Reference counterparts: pkg/watch/manager_test.go,
+constrainttemplate_controller_test.go, config_controller_test.go,
+ready_tracker_test.go — run here against the FakeCluster instead of
+envtest's local apiserver.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget, RegoDriver
+from gatekeeper_tpu.control import (
+    CONFIG_GVK,
+    FakeCluster,
+    GVK,
+    OPERATION_AUDIT,
+    OPERATION_STATUS,
+    OPERATION_WEBHOOK,
+    Runner,
+    TEMPLATE_GVK,
+    WatchManager,
+    constraint_gvk,
+    load_yaml_dir,
+)
+from gatekeeper_tpu.metrics import MetricsRegistry
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+REQ_LABELS = """package reqlabels
+
+violation[{"msg": msg}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("missing: %v", [missing])
+}
+"""
+
+DENY_ALL = """package denyall
+
+violation[{"msg": "always denied"}] { true }
+"""
+
+
+def template(kind, rego):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    }
+
+
+def constraint(kind, name, params=None, match=None, enforcement=None):
+    spec = {}
+    if params is not None:
+        spec["parameters"] = params
+    if match is not None:
+        spec["match"] = match
+    if enforcement is not None:
+        spec["enforcementAction"] = enforcement
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def pod(name, ns="default", labels=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"containers": [{"name": "main", "image": "nginx"}]},
+    }
+
+
+def config(sync_kinds=(("", "v1", "Pod"),), match=None):
+    return {
+        "apiVersion": "config.gatekeeper.sh/v1alpha1",
+        "kind": "Config",
+        "metadata": {"name": "config", "namespace": "gatekeeper-system"},
+        "spec": {
+            "sync": {
+                "syncOnly": [
+                    {"group": g, "version": v, "kind": k}
+                    for g, v, k in sync_kinds
+                ]
+            },
+            **({"match": match} if match else {}),
+        },
+    }
+
+
+def new_client():
+    return Backend(RegoDriver()).new_client(K8sValidationTarget())
+
+
+def make_runner(cluster, **kw):
+    kw.setdefault("audit_interval", 3600.0)
+    return Runner(cluster, new_client(), TARGET, **kw)
+
+
+def audit_results(runner):
+    return runner.audit.audit()
+
+
+# ---------------------------------------------------------------------------
+# watch manager
+
+
+def test_watch_refcount_and_replay():
+    cluster = FakeCluster()
+    gvk = GVK("", "v1", "Pod")
+    cluster.apply(pod("pre-existing"))
+    mgr = WatchManager(cluster)
+    seen_a, seen_b = [], []
+    ra = mgr.new_registrar("a", seen_a.append)
+    rb = mgr.new_registrar("b", seen_b.append)
+
+    ra.add_watch(gvk)
+    mgr.wait_idle()
+    assert [e.obj["metadata"]["name"] for e in seen_a] == ["pre-existing"]
+
+    # late joiner gets a replay of current state, not nothing
+    rb.add_watch(gvk)
+    mgr.wait_idle()
+    assert [e.obj["metadata"]["name"] for e in seen_b] == ["pre-existing"]
+
+    # live events fan out to both
+    cluster.apply(pod("now"))
+    mgr.wait_idle()
+    assert seen_a[-1].obj["metadata"]["name"] == "now"
+    assert seen_b[-1].obj["metadata"]["name"] == "now"
+
+    # removal: a leaves, b still receives; b leaves, subscription gone
+    ra.remove_watch(gvk)
+    cluster.apply(pod("after-a-left"))
+    mgr.wait_idle()
+    assert seen_a[-1].obj["metadata"]["name"] == "now"
+    assert seen_b[-1].obj["metadata"]["name"] == "after-a-left"
+    rb.remove_watch(gvk)
+    assert mgr.watched_gvks() == set()
+    cluster.apply(pod("unwatched"))
+    mgr.wait_idle()
+    assert seen_b[-1].obj["metadata"]["name"] == "after-a-left"
+    mgr.stop()
+
+
+def test_replace_watch_swaps_set():
+    cluster = FakeCluster()
+    mgr = WatchManager(cluster)
+    seen = []
+    r = mgr.new_registrar("sync", seen.append)
+    pods, svcs = GVK("", "v1", "Pod"), GVK("", "v1", "Service")
+    r.replace_watch({pods})
+    assert r.watched() == {pods}
+    r.replace_watch({svcs})
+    assert r.watched() == {svcs}
+    cluster.apply(pod("p1"))
+    mgr.wait_idle()
+    assert seen == []  # pod watch was removed
+    mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# boot to ready + serving
+
+
+@pytest.fixture
+def booted():
+    cluster = FakeCluster()
+    cluster.apply(template("K8sRequiredLabels", REQ_LABELS))
+    cluster.apply(
+        constraint(
+            "K8sRequiredLabels", "need-owner", params={"labels": ["owner"]}
+        )
+    )
+    cluster.apply(config())
+    cluster.apply(pod("good", labels={"owner": "me"}))
+    cluster.apply(pod("bad"))
+    runner = make_runner(cluster, readyz_port=0)
+    runner.start()
+    assert runner.wait_ready(30), runner.tracker.stats()
+    yield cluster, runner
+    runner.stop()
+
+
+def test_boot_to_ready_and_audit(booted):
+    cluster, runner = booted
+    report = audit_results(runner)
+    assert report.total_violations == 1
+    st = report.statuses["K8sRequiredLabels/need-owner"]
+    assert st.violations[0].name == "bad"
+
+    # /readyz serves 200 with stats
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{runner.readyz_port}/readyz"
+    ) as resp:
+        body = json.loads(resp.read())
+    assert resp.status == 200 and body["ready"] is True
+
+
+def test_webhook_serves_from_ingested_state(booted):
+    cluster, runner = booted
+    req = {
+        "uid": "u1",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": "CREATE",
+        "name": "incoming",
+        "namespace": "default",
+        "userInfo": {"username": "alice"},
+        "object": pod("incoming"),
+    }
+    body = json.dumps(
+        {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+         "request": req}
+    ).encode()
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{runner.webhook.port}/v1/admit",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(r) as resp:
+        out = json.loads(resp.read())
+    assert out["response"]["allowed"] is False
+    assert "need-owner" in out["response"]["status"]["message"]
+
+
+def test_readyz_503_before_ready():
+    cluster = FakeCluster()
+    cluster.apply(template("K8sRequiredLabels", REQ_LABELS))
+    runner = make_runner(cluster, readyz_port=0)
+    # expectations populated but watches never started -> not ready
+    runner._populate_expectations()
+    runner._serve_readyz()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{runner.readyz_port}/readyz"
+            )
+        assert exc.value.code == 503
+    finally:
+        runner._readyz_httpd.shutdown()
+    runner.watch_mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# churn
+
+
+def test_template_update_churn(booted):
+    cluster, runner = booted
+    # tighten the template: now requires both labels via new rego message
+    new_rego = REQ_LABELS.replace("missing: %v", "absent: %v")
+    cluster.apply(template("K8sRequiredLabels", new_rego))
+    runner.watch_mgr.wait_idle()
+    report = audit_results(runner)
+    assert report.total_violations == 1
+    msg = report.statuses["K8sRequiredLabels/need-owner"].violations[0].message
+    assert msg.startswith("absent:")
+
+
+def test_template_delete_removes_constraints(booted):
+    cluster, runner = booted
+    cluster.delete(template("K8sRequiredLabels", REQ_LABELS))
+    runner.watch_mgr.wait_idle()
+    report = audit_results(runner)
+    assert report.total_violations == 0
+
+
+def test_constraint_churn(booted):
+    cluster, runner = booted
+    cluster.apply(
+        constraint(
+            "K8sRequiredLabels", "need-team", params={"labels": ["team"]}
+        )
+    )
+    runner.watch_mgr.wait_idle()
+    assert audit_results(runner).total_violations == 3  # both pods lack team
+    cluster.delete(
+        constraint("K8sRequiredLabels", "need-team")
+    )
+    runner.watch_mgr.wait_idle()
+    assert audit_results(runner).total_violations == 1
+
+
+def test_data_churn_mid_run(booted):
+    cluster, runner = booted
+    cluster.apply(pod("bad2"))
+    runner.watch_mgr.wait_idle()
+    assert audit_results(runner).total_violations == 2
+    cluster.delete(pod("bad2"))
+    runner.watch_mgr.wait_idle()
+    assert audit_results(runner).total_violations == 1
+
+
+def test_config_swap_wipes_and_replays(booted):
+    cluster, runner = booted
+    # swap sync to Services only: pod data must be wiped
+    cluster.apply(config(sync_kinds=(("", "v1", "Service"),)))
+    runner.watch_mgr.wait_idle()
+    assert audit_results(runner).total_violations == 0
+    # swap back: pods replayed via the new watch's initial List
+    cluster.apply(config())
+    runner.watch_mgr.wait_idle()
+    assert audit_results(runner).total_violations == 1
+
+
+def test_config_excluder_applies_to_webhook(booted):
+    cluster, runner = booted
+    cluster.apply(
+        config(
+            match=[
+                {"processes": ["webhook"], "excludedNamespaces": ["kube-system"]}
+            ]
+        )
+    )
+    runner.watch_mgr.wait_idle()
+    resp = runner.webhook.handler.handle(
+        {
+            "uid": "u2",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "operation": "CREATE",
+            "name": "sys-pod",
+            "namespace": "kube-system",
+            "userInfo": {"username": "alice"},
+            "object": pod("sys-pod", ns="kube-system"),
+        }
+    )
+    assert resp.allowed is True
+    assert "ignored" in resp.message
+
+
+# ---------------------------------------------------------------------------
+# status plane
+
+
+def test_status_published_and_aggregated(booted):
+    cluster, runner = booted
+    runner.watch_mgr.wait_idle()
+    by_pod = runner.status_agg.template_by_pod("k8srequiredlabels")
+    assert len(by_pod) == 1 and by_pod[0]["errors"] == []
+    c_by_pod = runner.status_agg.constraint_by_pod(
+        "K8sRequiredLabels", "need-owner"
+    )
+    assert len(c_by_pod) == 1 and c_by_pod[0]["enforced"] is True
+
+
+def test_bad_template_reports_error_status(booted):
+    cluster, runner = booted
+    cluster.apply(template("K8sBroken", "package broken\nthis is not rego"))
+    runner.watch_mgr.wait_idle()
+    assert "k8sbroken" in runner.template_controller.errors
+    by_pod = runner.status_agg.template_by_pod("k8sbroken")
+    assert len(by_pod) == 1 and by_pod[0]["errors"]
+
+
+# ---------------------------------------------------------------------------
+# operations gating
+
+
+def test_operations_gating():
+    cluster = FakeCluster()
+    cluster.apply(template("K8sRequiredLabels", REQ_LABELS))
+    audit_only = make_runner(cluster, operations=[OPERATION_AUDIT])
+    audit_only.start()
+    assert audit_only.webhook is None and audit_only.audit is not None
+    assert audit_only.status_writer is None
+    audit_only.stop()
+
+    webhook_only = make_runner(cluster, operations=[OPERATION_WEBHOOK])
+    webhook_only.start()
+    assert webhook_only.webhook is not None and webhook_only.audit is None
+    webhook_only.stop()
+
+
+# ---------------------------------------------------------------------------
+# boot from a manifest directory
+
+
+def test_boot_from_yaml_dir(tmp_path):
+    import yaml
+
+    (tmp_path / "01-template.yaml").write_text(
+        yaml.safe_dump(template("K8sDenyAll", DENY_ALL))
+    )
+    (tmp_path / "02-constraint.yaml").write_text(
+        yaml.safe_dump(constraint("K8sDenyAll", "deny-everything"))
+    )
+    (tmp_path / "03-config.yaml").write_text(yaml.safe_dump(config()))
+    (tmp_path / "04-pod.yaml").write_text(yaml.safe_dump(pod("victim")))
+
+    cluster = FakeCluster()
+    n = load_yaml_dir(cluster, str(tmp_path))
+    assert n == 4
+    runner = make_runner(cluster)
+    runner.start()
+    assert runner.wait_ready(30), runner.tracker.stats()
+    report = audit_results(runner)
+    assert report.total_violations == 1
+    assert report.statuses["K8sDenyAll/deny-everything"].violations[0].name == (
+        "victim"
+    )
+    runner.stop()
