@@ -1,18 +1,28 @@
-"""Benchmark: full-cluster audit throughput, TPU driver vs CPU baseline.
+"""Benchmark: full-cluster audit throughput + admission latency, TPU
+driver vs CPU baseline.
 
-Workload modeled on BASELINE.md config #5 (cluster-scale audit) with the
-template mix of configs #2/#3: N synthetic pods x C constraints drawn
-from the compiled library templates (PSP + general), ~1% violation rate.
-The CPU baseline is the interpreter driver (RegoDriver — the counterpart
-of the reference's drivers/local) measured on a subsample and scaled to
-constraint-evals/sec; the reference harness it mirrors is
-pkg/webhook/policy_benchmark_test.go:233-329 (PSP templates, constraint
-loads up to 2000).
+Three phases (BASELINE.md configs):
+  1. clean audit — config #5: N synthetic pods x C constraints from the
+     compiled library templates (PSP + general), ~1% violation rate;
+  2. adversarial audit — configs #2/#3/#5 mixed: mixed GVKs (Pod/
+     Service/Ingress/Namespace), 1..16 containers, label-cardinality
+     spread, screen templates (seccomp + the data.inventory joins) in
+     the constraint mix; reports the compiled/interp pair split;
+  3. admission replay — config #4: 10k AdmissionReviews x 50
+     constraints through the micro-batching handler (p50/p99),
+     subsampled at low concurrencies (bench_webhook.py).
 
-Prints exactly ONE JSON line on stdout:
-  {"metric": "audit_constraint_evals_per_sec_per_chip",
-   "value": ..., "unit": "evals/s", "vs_baseline": ...}
-plus human-readable detail on stderr.
+CPU baseline honesty: the measured baseline is THIS repo's Python Rego
+interpreter (architecture mirror of the reference's one-interpreted-
+query-per-object audit, pkg/audit/manager.go:232-342). The reference's
+actual engine is Go OPA, for which no toolchain exists in this image;
+`vs_baseline` therefore scales the measured Python rate by a
+conservative GO_SPEEDUP_PROXY=50x (Go topdown is typically 20-60x a
+straight Python interpreter on this workload class) and reports both
+numbers. The raw Python-relative multiplier is in
+detail.speedup_vs_python_interp.
+
+Prints exactly ONE JSON line on stdout; human detail on stderr.
 
 Usage: python bench.py [N_RESOURCES] [N_CONSTRAINTS]   (default 100000 500)
 """
@@ -28,6 +38,7 @@ import numpy as np
 
 TARGET = "admission.k8s.gatekeeper.sh"
 LIB = "/root/reference/library"
+GO_SPEEDUP_PROXY = 50.0  # conservative Go-OPA-vs-Python-interp factor
 
 
 def _load_template(path):
@@ -37,9 +48,13 @@ def _load_template(path):
         return yaml.safe_load(f)
 
 
-def _constraint(kind, name, params=None):
+def _constraint(kind, name, params=None, kinds=(("", "Pod"),)):
     spec = {
-        "match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+        "match": {
+            "kinds": [
+                {"apiGroups": [g], "kinds": [k]} for g, k in kinds
+            ]
+        },
     }
     if params is not None:
         spec["parameters"] = params
@@ -51,8 +66,8 @@ def _constraint(kind, name, params=None):
     }
 
 
-# (template dir, kind, params variants) — the compiled subset; params
-# cycle so same-template constraints exercise distinct const tensors
+# (template dir, kind, params variants) — the precisely-compiled subset;
+# params cycle so same-template constraints exercise distinct consts
 TEMPLATE_MIX = [
     (f"{LIB}/pod-security-policy/privileged-containers",
      "K8sPSPPrivilegedContainer", [None]),
@@ -78,8 +93,19 @@ TEMPLATE_MIX = [
     ]),
 ]
 
+# adversarial additions: screen-compiled templates (seccomp annotation
+# join, the two data.inventory joins)
+ADVERSARIAL_EXTRA = [
+    (f"{LIB}/pod-security-policy/seccomp", "K8sPSPSeccomp",
+     [{"allowedProfiles": ["runtime/default"]}], (("", "Pod"),)),
+    (f"{LIB}/general/uniqueingresshost", "K8sUniqueIngressHost",
+     [None], (("extensions", "Ingress"), ("networking.k8s.io", "Ingress"))),
+    (f"{LIB}/general/uniqueserviceselector", "K8sUniqueServiceSelector",
+     [None], (("", "Service"),)),
+]
 
-def make_pod(i):
+
+def make_pod(i, max_containers=1):
     # sparse violations (steady-state clusters are mostly compliant; each
     # bad pod violates every matching constraint of that template, so the
     # violating-pair count is ~bad_pods x constraints_per_template)
@@ -90,77 +116,104 @@ def make_pod(i):
     sc = {}
     if i % 5009 == 0:
         sc = {"securityContext": {"privileged": True}}
-    c = {
-        "name": "main",
-        "image": image,
-        "resources": {"limits": {"cpu": "1", "memory": "2Gi"}},
-        **sc,
+    n_ctr = 1 + (i % max_containers) if max_containers > 1 else 1
+    containers = []
+    for c in range(n_ctr):
+        containers.append(
+            {
+                "name": f"c{c}",
+                "image": image if c == 0 else "nginx",
+                "resources": {"limits": {"cpu": "1", "memory": "2Gi"}},
+                **(sc if c == 0 else {}),
+            }
+        )
+    meta = {
+        "name": f"p{i}",
+        "namespace": f"ns{i % 23}",
+        "labels": labels,
     }
+    if max_containers > 1 and i % 37 == 0:
+        # label-cardinality spread + seccomp-relevant annotations
+        meta["labels"] = {**labels, **{f"k{j}": f"v{j}" for j in range(i % 9)}}
+        meta["annotations"] = {
+            "seccomp.security.alpha.kubernetes.io/pod": (
+                "runtime/default" if i % 2 else "unconfined"
+            )
+        }
     return {
         "apiVersion": "v1",
         "kind": "Pod",
-        "metadata": {
-            "name": f"p{i}",
-            "namespace": f"ns{i % 23}",
-            "labels": labels,
-        },
-        "spec": {"containers": [c]},
+        "metadata": meta,
+        "spec": {"containers": containers},
     }
 
 
-def build_client(driver, n_resources, n_constraints):
+def make_mixed(i):
+    """Mixed-GVK corpus row: mostly pods, with services/ingresses/
+    namespaces sprinkled in (config #5 says mixed-GVK)."""
+    r = i % 20
+    if r == 17:
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": f"svc{i}", "namespace": f"ns{i % 23}"},
+            "spec": {"selector": {"app": f"svc{i % 41}"}},
+        }
+    if r == 18:
+        return {
+            "apiVersion": "networking.k8s.io/v1beta1",
+            "kind": "Ingress",
+            "metadata": {"name": f"ing{i}", "namespace": f"ns{i % 23}"},
+            "spec": {"rules": [{"host": f"h{i % 997}.example.com"}]},
+        }
+    if r == 19:
+        return {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": f"extra-ns{i}", "labels": {"env": "x"}},
+        }
+    return make_pod(i, max_containers=16)
+
+
+def build_client(driver, n_resources, n_constraints, adversarial=False):
     from gatekeeper_tpu.constraint import Backend, K8sValidationTarget
 
     client = Backend(driver).new_client(K8sValidationTarget())
-    for tdir, kind, _ in TEMPLATE_MIX:
-        client.add_template(_load_template(f"{tdir}/template.yaml"))
+    mix = [(t, k, v, (("", "Pod"),)) for t, k, v in TEMPLATE_MIX]
+    if adversarial:
+        mix = mix + ADVERSARIAL_EXTRA
+    seen = set()
+    for tdir, kind, _v, _k in mix:
+        if tdir not in seen:
+            client.add_template(_load_template(f"{tdir}/template.yaml"))
+            seen.add(tdir)
     i = 0
     while i < n_constraints:
-        tdir, kind, variants = TEMPLATE_MIX[i % len(TEMPLATE_MIX)]
-        params = variants[(i // len(TEMPLATE_MIX)) % len(variants)]
-        client.add_constraint(_constraint(kind, f"c{i}", params))
+        tdir, kind, variants, kinds = mix[i % len(mix)]
+        params = variants[(i // len(mix)) % len(variants)]
+        client.add_constraint(_constraint(kind, f"c{i}", params, kinds))
         i += 1
+    make = make_mixed if adversarial else make_pod
     for j in range(n_resources):
-        client.add_data(make_pod(j))
+        client.add_data(make(j))
     return client
 
 
-def main():
-    n_resources = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
-    n_constraints = int(sys.argv[2]) if len(sys.argv) > 2 else 500
-    err = sys.stderr
-
-    import jax
-    from gatekeeper_tpu.constraint import RegoDriver
+def run_audit_phase(n_resources, n_constraints, adversarial, err):
     from gatekeeper_tpu.constraint import TpuDriver
 
-    print(f"devices: {jax.devices()}", file=err)
-
-    # -- CPU baseline (subsample, interpreter driver) -----------------------
-    cpu_n, cpu_c = min(100, n_resources), min(25, n_constraints)
-    cpu_client = build_client(RegoDriver(), cpu_n, cpu_c)
-    t0 = time.perf_counter()
-    cpu_results = cpu_client.audit().by_target[TARGET].results
-    cpu_t = time.perf_counter() - t0
-    cpu_evals = cpu_n * cpu_c
-    cpu_rate = cpu_evals / cpu_t
-    print(
-        f"cpu baseline: {cpu_n}x{cpu_c} = {cpu_evals} evals in {cpu_t:.2f}s "
-        f"-> {cpu_rate:,.0f} evals/s ({len(cpu_results)} violations)",
-        file=err,
-    )
-
-    # -- TPU driver ---------------------------------------------------------
+    label = "adversarial" if adversarial else "clean"
     drv = TpuDriver()
     t0 = time.perf_counter()
-    client = build_client(drv, n_resources, n_constraints)
-    print(f"ingest: {time.perf_counter()-t0:.1f}s", file=err)
+    client = build_client(drv, n_resources, n_constraints, adversarial)
+    ingest_t = time.perf_counter() - t0
+    print(f"[{label}] ingest: {ingest_t:.1f}s", file=err)
 
     t0 = time.perf_counter()
     results = client.audit().by_target[TARGET].results
     warm_t = time.perf_counter() - t0
     print(
-        f"first sweep (encode+compile): {warm_t:.1f}s, "
+        f"[{label}] first sweep (encode+compile): {warm_t:.1f}s, "
         f"{len(results)} violations, stats={drv.stats}",
         file=err,
     )
@@ -171,16 +224,76 @@ def main():
         results = client.audit().by_target[TARGET].results
         sweep_times.append(time.perf_counter() - t0)
     best = min(sweep_times)
-    evals = n_resources * n_constraints
-    rate = evals / best
+    rate = n_resources * n_constraints / best
     print(
-        f"steady-state sweeps: {['%.3fs' % t for t in sweep_times]} "
-        f"-> best {best:.3f}s = {rate:,.0f} evals/s "
-        f"({len(results)} violations)",
+        f"[{label}] steady-state sweeps: "
+        f"{['%.3fs' % t for t in sweep_times]} -> best {best:.3f}s = "
+        f"{rate:,.0f} evals/s ({len(results)} violations)",
         file=err,
     )
+    return {
+        "sweep_seconds": round(best, 4),
+        "evals_per_sec": round(rate, 1),
+        "violations": len(results),
+        "first_sweep_seconds": round(warm_t, 1),
+        "ingest_seconds": round(ingest_t, 1),
+        "compiled_pairs": drv.stats.get("compiled_pairs"),
+        "interp_pairs": drv.stats.get("interp_pairs"),
+    }
+
+
+def main():
+    n_resources = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    n_constraints = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+    err = sys.stderr
+
+    import jax
+    from gatekeeper_tpu.constraint import RegoDriver
+
+    print(f"devices: {jax.devices()}", file=err)
+
+    # -- CPU baseline (subsample, interpreter driver) -----------------------
+    cpu_n, cpu_c = min(100, n_resources), min(25, n_constraints)
+    cpu_client = build_client(RegoDriver(), cpu_n, cpu_c)
+    t0 = time.perf_counter()
+    cpu_results = cpu_client.audit().by_target[TARGET].results
+    cpu_t = time.perf_counter() - t0
+    cpu_rate = cpu_n * cpu_c / cpu_t
     print(
-        f"speedup vs cpu interpreter baseline: {rate / cpu_rate:.1f}x",
+        f"cpu baseline: {cpu_n}x{cpu_c} evals in {cpu_t:.2f}s -> "
+        f"{cpu_rate:,.0f} evals/s ({len(cpu_results)} violations); "
+        f"go-proxy baseline = {cpu_rate * GO_SPEEDUP_PROXY:,.0f} evals/s "
+        f"(x{GO_SPEEDUP_PROXY:.0f} documented proxy)",
+        file=err,
+    )
+
+    # -- audit phases -------------------------------------------------------
+    clean = run_audit_phase(n_resources, n_constraints, False, err)
+    adv = run_audit_phase(n_resources, n_constraints, True, err)
+
+    # -- webhook replay (config #4) -----------------------------------------
+    from bench_webhook import run_webhook_bench
+
+    webhook = run_webhook_bench(10_000, 50, err=err)
+    # reference-comparable number: 100%-violating at low concurrency
+    # (policy_benchmark_test.go's shape); allow-path p50 alongside
+    p50 = next(
+        r["p50_ms"]
+        for r in webhook["tpu_batched"]
+        if r["violating"] and r["concurrency"] == 8
+    )
+    p50_allow = next(
+        r["p50_ms"]
+        for r in webhook["tpu_batched"]
+        if not r["violating"] and r["concurrency"] == 8
+    )
+
+    rate = clean["evals_per_sec"]
+    vs_python = rate / cpu_rate
+    vs_go_proxy = rate / (cpu_rate * GO_SPEEDUP_PROXY)
+    print(
+        f"speedup: {vs_python:,.0f}x vs python interp, "
+        f"{vs_go_proxy:,.0f}x vs documented go-proxy baseline",
         file=err,
     )
 
@@ -188,16 +301,22 @@ def main():
         json.dumps(
             {
                 "metric": "audit_constraint_evals_per_sec_per_chip",
-                "value": round(rate, 1),
+                "value": rate,
                 "unit": "evals/s",
-                "vs_baseline": round(rate / cpu_rate, 2),
+                "vs_baseline": round(vs_go_proxy, 2),
                 "detail": {
                     "n_resources": n_resources,
                     "n_constraints": n_constraints,
-                    "sweep_seconds": round(best, 4),
-                    "violations": len(results),
-                    "cpu_evals_per_sec": round(cpu_rate, 1),
+                    "clean": clean,
+                    "adversarial": adv,
+                    "webhook": webhook,
+                    "webhook_p50_ms": p50,
+                    "webhook_p50_allow_ms": p50_allow,
+                    "cpu_python_evals_per_sec": round(cpu_rate, 1),
+                    "go_speedup_proxy": GO_SPEEDUP_PROXY,
+                    "speedup_vs_python_interp": round(vs_python, 1),
                     "north_star": "100k x 500 < 2s",
+                    "north_star_met": clean["sweep_seconds"] < 2.0,
                 },
             }
         )

@@ -129,6 +129,7 @@ class AuditManager:
         self._thread: Optional[threading.Thread] = None
         self.last_run_seconds: Optional[float] = None
         self.audit_duration_seconds: Optional[float] = None
+        self._reported_eas: set = set()
         self.last_error: Optional[BaseException] = None
         self.error_count = 0
 
@@ -211,13 +212,18 @@ class AuditManager:
         self.audit_duration_seconds = duration
         if self.metrics is not None:
             # the audit stats reporter's metric surface
-            # (pkg/audit/stats_reporter.go; docs/Metrics.md:83-104)
+            # (pkg/audit/stats_reporter.go; docs/Metrics.md:83-104);
+            # enforcement actions seen in PRIOR sweeps re-report 0 when
+            # their violations clear, so series never go stale
             self.metrics.observe("audit_duration_seconds", duration)
             self.metrics.gauge("audit_last_run_time", t0)
-            for ea, n in totals_by_ea.items():
+            for ea in set(totals_by_ea) | self._reported_eas:
                 self.metrics.gauge(
-                    "violations", n, enforcement_action=ea
+                    "violations",
+                    totals_by_ea.get(ea, 0),
+                    enforcement_action=ea,
                 )
+            self._reported_eas |= set(totals_by_ea)
         return report
 
     # -- sweep loop (auditManagerLoop, manager.go:344-358) -------------------

@@ -281,12 +281,16 @@ class RegoDriver(Driver):
         review: Any,
         inventory: Any,
         trace: Optional[List[str]],
+        frozen_review: Any = None,
     ) -> List[Result]:
+        """`frozen_review`: callers rendering MANY constraints against
+        one review pre-freeze it once (values.freeze re-freezes frozen
+        Objs in O(1)); freeze was ~30% of per-pair render time."""
         kind = constraint.get("kind")
         if not isinstance(kind, str):
             return []
         input_doc = {
-            "review": review,
+            "review": review if frozen_review is None else frozen_review,
             "parameters": M.constraint_parameters(constraint),
         }
         violations = self.interp.query_violations(

@@ -77,6 +77,13 @@ G_CAP = 64
 # every chunk (padded) reuses.
 N_CHUNK = 32768
 
+# Adaptive micro-batch routing: review batches below this size evaluate
+# serially on the interpreter — a per-request interp review costs ~10ms
+# while a fused device dispatch pays a fixed round trip (~100-200ms on a
+# tunneled chip) plus encode/stage; large batches amortize it. Tunable
+# per deployment (a locally-attached chip could set this to ~2).
+MIN_DEVICE_BATCH = 12
+
 
 def _params_key(params: Any) -> str:
     return json.dumps(params, sort_keys=True, default=str)
@@ -232,14 +239,19 @@ class TpuDriver(RegoDriver):
     def _make_oracle(self, target: str, kind: str, params: Any):
         """Interpreter-backed helper-function oracle for the symbolic
         compiler: evaluates pure template helpers (canonify_cpu and
-        friends) to build per-vocab-entry lookup tables."""
-        pkg_path = ["templates", target, kind]
+        friends) to build per-vocab-entry lookup tables.
+
+        The package node and evaluation context are built once and
+        reused across the whole table fill — the fill runs the oracle
+        per vocab entry (hundreds of thousands of calls on a large
+        corpus), and the shared context's function-result cache also
+        memoizes the helpers' own inner calls (mem_multiple & co)."""
+        node = self.interp._pkg_node(["templates", target, kind], create=False)
+        if node is None:
+            return lambda fn_name, value: (None, False)
+        ctx = self.interp.make_context({"parameters": params}, {})
 
         def oracle_fn(fn_name: str, value: Any):
-            node = self.interp._pkg_node(pkg_path, create=False)
-            if node is None:
-                return None, False
-            ctx = self.interp.make_context({"parameters": params}, {})
             try:
                 v = _call_function(ctx, None, node, fn_name, [freeze(value)])
             except RegoError:
@@ -269,6 +281,7 @@ class TpuDriver(RegoDriver):
             self.tables,
             oracle_fn=self._make_oracle(target, kind, params),
             oracle_ns=f"{kind}|{key[2]}",
+            oracle_ns_shared=f"{target}|{kind}",
         )
         try:
             prog = compile_program(env, mods, params)
@@ -526,6 +539,20 @@ class TpuDriver(RegoDriver):
         ):
             return super().query_many(path, inputs, tracing)
         target = m.group(1)
+        if len(inputs) < MIN_DEVICE_BATCH:
+            # adaptive routing: a tiny batch finishes faster on the
+            # serial interpreter than a device round trip would take
+            # (results are bit-identical by the driver-parity contract)
+            with self._mutex:
+                return [
+                    Response(
+                        target=target,
+                        results=RegoDriver._violation(
+                            self, target, i or {}, None
+                        ),
+                    )
+                    for i in inputs
+                ]
         with self._mutex:
             constraints = self._constraints(target)
             ns_cache = self._ns_cache(target)
@@ -629,14 +656,18 @@ class TpuDriver(RegoDriver):
                 render_cache = cached[1]
             per_review: List[List[Result]] = [[] for _ in reviews]
             n_results = 0
+            frozen: Dict[int, Any] = {}  # review idx -> frozen review
             for n_i, c_i in pairs:
                 out = None
                 if render_cache is not None:
                     out = render_cache.get((n_i, c_i))
                 if out is None:
+                    fr = frozen.get(n_i)
+                    if fr is None:
+                        fr = frozen[n_i] = freeze(reviews[n_i])
                     out = self._eval_template(
                         target, cs.constraints[c_i], reviews[n_i],
-                        inventory, trace
+                        inventory, trace, frozen_review=fr
                     )
                     if render_cache is not None:
                         render_cache[(n_i, c_i)] = out

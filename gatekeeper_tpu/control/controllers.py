@@ -264,6 +264,13 @@ class SyncController:
             and self.excluder is not None
             and self.excluder.is_namespace_excluded("sync", ns)
         ):
+            if self.tracker is not None:
+                # the boot lister may have expected this object before
+                # the excluder was configured — an excluded object must
+                # not wedge /readyz
+                self.tracker.for_data(str(ev.gvk)).cancel_expect(
+                    (ns, meta.get("name") or "")
+                )
             return
         t0 = time.perf_counter()
         if ev.type == DELETED:
@@ -302,6 +309,7 @@ class ConfigController:
         tracker: Optional[ReadinessTracker] = None,
         switch: Optional[ControllerSwitch] = None,
         metrics=None,
+        trace_config=None,
     ):
         self.client = client
         self.sync_registrar = sync_registrar
@@ -310,6 +318,7 @@ class ConfigController:
         self.tracker = tracker
         self.switch = switch
         self.metrics = metrics
+        self.trace_config = trace_config
 
     def sink(self, ev: Event) -> None:
         if self.switch is not None and not self.switch.enter():
@@ -322,8 +331,14 @@ class ConfigController:
             return  # only the keyed singleton is honored (keys/config.go)
         spec = {} if ev.type == DELETED else (ev.obj.get("spec") or {})
 
-        # 1. process excluder from spec.match (excluder.go:43)
+        # 1. process excluder from spec.match (excluder.go:43) and the
+        # admission trace rules from spec.validation.traces
+        # (config_types.go:39-51)
         self.excluder.replace(spec.get("match") or [])
+        if self.trace_config is not None:
+            self.trace_config.replace(
+                (spec.get("validation") or {}).get("traces") or []
+            )
 
         # 2. new sync-only set
         sync_only: Set[GVK] = set()
@@ -340,9 +355,14 @@ class ConfigController:
         # Lists rebuild from scratch (config_controller.go:268)
         self.client.remove_data(WipeData())
 
-        # 4. swap watches; the initial List each new watch feeds through
-        # the distribution pipe is the replay (config_controller.go:294)
+        # 4. swap watches; dropping to the empty set first forces every
+        # retained GVK's watch to tear down and re-add, so the initial
+        # List replay rebuilds the data we just wiped for ALL GVKs in
+        # the new set — the reference's replayData re-lists every
+        # watched GVK, not only newly-added ones
+        # (config_controller.go:294-331)
         self.sync_controller.set_sync_set(sync_only)
+        self.sync_registrar.replace_watch(set())
         self.sync_registrar.replace_watch(sync_only)
 
         if self.tracker is not None:

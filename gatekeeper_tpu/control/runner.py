@@ -66,6 +66,8 @@ class Runner:
         readyz_port: Optional[int] = 0,  # None disables the endpoint
         exempt_namespaces: Sequence[str] = (),
         webhook_tls: bool = False,
+        emit_admission_events: bool = False,
+        emit_audit_events: bool = False,
     ):
         self.cluster = cluster
         self.client = client
@@ -94,6 +96,17 @@ class Runner:
         self.webhook = None
         self.audit = None
         self._readyz_httpd: Optional[ThreadingHTTPServer] = None
+        from ..webhook.policy import TraceConfig
+
+        self.trace_config = TraceConfig()
+        self.emit_admission_events = emit_admission_events
+        self.emit_audit_events = emit_audit_events
+        # K8s Events stand-in: a BOUNDED ring of emitted violation
+        # events (audit re-emits persisting violations every sweep; an
+        # unbounded list would leak for the process lifetime)
+        from collections import deque
+
+        self.events: Any = deque(maxlen=4096)
 
         # controllers (wired, not yet watching)
         self.constraint_controller = ConstraintController(
@@ -136,6 +149,7 @@ class Runner:
             tracker=self.tracker,
             switch=self.switch,
             metrics=metrics,
+            trace_config=self.trace_config,
         )
         self._config_registrar = self.watch_mgr.new_registrar(
             "config-controller", self.config_controller.sink
@@ -217,6 +231,9 @@ class Runner:
                 exempt_namespaces=self.exempt_namespaces,
                 metrics=self.metrics,
                 tls=self.webhook_tls,
+                trace_config=self.trace_config,
+                event_sink=self.events.append,
+                emit_admission_events=self.emit_admission_events,
             )
             self.webhook.start()
 
@@ -228,6 +245,8 @@ class Runner:
                 self.target,
                 audit_interval=self.audit_interval,
                 metrics=self.metrics,
+                event_sink=self.events.append,
+                emit_audit_events=self.emit_audit_events,
             )
             self.audit.start()
 

@@ -29,19 +29,41 @@ class Program:
     consts: Dict[str, np.ndarray]
     signature: Tuple
     g_max: int = 8  # array-axis fanout the program was evaluated with
+    # screen programs over-approximate (inventory-join conditions are
+    # dropped, symbolic.InventoryDependent); exact results come from the
+    # interpreter re-check of flagged pairs
+    screen: bool = False
 
 
 def compile_program(
     env: CompilerEnv, modules: Sequence[A.Module], params: Any
 ) -> Program:
-    comp = Compiler(env, modules, params)
-    expr = comp.compile_violation_counts()
+    try:
+        comp = Compiler(env, modules, params)
+        expr = comp.compile_violation_counts()
+    except CompileUnsupported:
+        # retry as a screen: uncompilable calls/comprehensions become
+        # opaque and conditions on them drop — a sound over-approximation
+        # whose flagged pairs the driver re-checks via the interpreter.
+        # This keeps inventory joins (uniqueingresshost/-serviceselector)
+        # and intra-object joins (seccomp/apparmor annotation matching)
+        # on the device path for the dense non-matching bulk.
+        comp = Compiler(env, modules, params, screen_mode=True)
+        expr = comp.compile_violation_counts()
+        comp.uses_inventory = True
     env.patterns.sync()
     env.tables.sync()
     sig = tuple(
         x if not isinstance(x, list) else tuple(x) for x in comp.signature
     )
-    return Program(expr=expr, consts=comp.pool.values, signature=sig)
+    if comp.uses_inventory:
+        sig = sig + (("inventory-screen",),)
+    return Program(
+        expr=expr,
+        consts=comp.pool.values,
+        signature=sig,
+        screen=comp.uses_inventory,
+    )
 
 
 class ProgramEvaluator:

@@ -75,8 +75,29 @@ from .tables import StrTables
 NEG_INF = -(10.0**30)
 
 
+# bump when oracle/interpreter evaluation semantics change: salts the
+# persisted oracle-table memo keys (engine/tables.py _load/_save_persist)
+ORACLE_MEMO_VERSION = 1
+
+
 class CompileUnsupported(Exception):
     """Template uses constructs outside the compilable subset."""
+
+
+class InventoryDependent(Exception):
+    """A condition's truth depends on `data.inventory` content.
+
+    Raised when a comparison/truthiness touches an inventory-derived
+    value; caught at the statement level, where the conjunct is DROPPED
+    — a sound over-approximation (weakening a conjunction can only add
+    violations). Programs compiled this way are *screens*: the sparse
+    pairs they flag are re-evaluated exactly by the interpreter with the
+    real inventory (TpuDriver._eval_template), so audit/review results
+    stay bit-exact while the dense non-matching bulk never leaves the
+    device. This is how the reference's cross-join templates
+    (uniqueingresshost / uniqueserviceselector,
+    library/general/*/template.yaml; evaluated by the reference via the
+    audit cross-join in regolib/src.go:45-62) ride the compiled path."""
 
 
 @dataclass
@@ -89,8 +110,13 @@ class CompilerEnv:
     # per-vocab-entry lookup tables for functions the symbolic compiler
     # can't inline (string canonicalizers like canonify_cpu)
     oracle_fn: Optional[Callable[[str, Any], Tuple[Any, bool]]] = None
-    # namespace for oracle-built tables (unique per template)
+    # namespace for oracle-built tables (unique per template+params)
     oracle_ns: str = ""
+    # params-free namespace (unique per template only): tables for
+    # helpers whose call graph never reads input.parameters register
+    # here, so constraint params variants share one fill — the fill is
+    # the expensive part (one interpreter call per vocab entry)
+    oracle_ns_shared: str = ""
 
 
 class ConstPool:
@@ -141,6 +167,15 @@ class SConst(SVal):
 
 class SInput(SVal):
     """The bare `input` document (proc-mount passes it to a helper)."""
+
+
+class SInventory(SVal):
+    """Opaque value: walks and calls propagate it; any condition on it
+    raises InventoryDependent (see that class). Produced by
+    `data.inventory` refs always, and — in screen mode — by calls and
+    comprehensions outside the compilable subset (a flatten_selector-
+    style derived string whose only use is an inventory comparison needs
+    no device value at all)."""
 
 
 @dataclass
@@ -361,7 +396,13 @@ class Compiler:
         env: CompilerEnv,
         modules: Sequence[A.Module],
         params: Any,
+        screen_mode: bool = False,
     ):
+        # screen mode: calls/comprehensions outside the compilable
+        # subset become opaque SInventory values instead of aborting —
+        # the program over-approximates and flagged pairs re-check via
+        # the interpreter (compile_program's fallback retry)
+        self.screen_mode = screen_mode
         self.cenv = env
         self.vocab = env.vocab
         self.patterns = env.patterns
@@ -375,6 +416,9 @@ class Compiler:
                 self.rules.setdefault(rule.head.name, []).append(rule)
         self._fn_depth = 0
         self.signature: List[Any] = []  # structural program signature
+        self.uses_inventory = False  # compiled as a screen (see
+        # InventoryDependent): flagged pairs re-check via interpreter
+        self._no_inv_catch = 0  # >0 inside negation bodies
 
     def _pattern(self, segs: Tuple[str, ...]) -> int:
         idx = self.patterns.register(segs)
@@ -428,7 +472,17 @@ class Compiler:
         for st in finals:
             # the head must evaluate too (undefined heads drop violations);
             # its render-signature drives cross-clause set dedup
-            head_forks = self._eval_term(rule.head.key, st)
+            try:
+                head_forks = self._eval_term(rule.head.key, st)
+            except InventoryDependent:
+                # head value depends on opaque content: keep the branch
+                # with a unique (no-dedup) signature — over-counting is
+                # fine for a screen, the interpreter renders exact sets
+                cond = self._conj(st)
+                outs.append(
+                    (("inv-head", id(rule), len(outs)), cond.space, cond)
+                )
+                continue
             for hv, hs in head_forks:
                 cond = self._conj(hs)
                 outs.append((_freeze_sig(_val_sig(hv)), cond.space, cond))
@@ -457,6 +511,21 @@ class Compiler:
         return states
 
     def _eval_expr(self, expr: A.Expr, st: State) -> List[State]:
+        try:
+            return self._eval_expr_inner(expr, st)
+        except InventoryDependent:
+            # the conjunct's truth depends on inventory content: DROP it
+            # (treat as satisfiable) — sound over-approximation in both
+            # polarities since the WHOLE statement (including any `not`)
+            # is what drops (inside a negation body the exception
+            # re-raises so `not P(inv)` never resolves to inner-defined/
+            # undefined, which would under-approximate); the interpreter
+            # re-checks flagged pairs with the real inventory
+            if self._no_inv_catch:
+                raise
+            return [st]
+
+    def _eval_expr_inner(self, expr: A.Expr, st: State) -> List[State]:
         if isinstance(expr, A.SomeDecl):
             return [st]
         if isinstance(expr, A.Assign):
@@ -518,9 +587,32 @@ class Compiler:
             return self._eval_cond_term(lhs, st)
         return self._eval_cond_term(A.BinOp(op="==", lhs=lhs, rhs=rhs), st)
 
+    def _inv_barrier(self):
+        """Context manager: InventoryDependent raised inside must escape
+        to the ENCLOSING construct instead of dropping an inner conjunct.
+        Dropping is only sound where a weaker condition can only ADD
+        violations — the top-level clause conjunction. Inside negation
+        bodies, comprehension bodies, function bodies, and referenced
+        rule bodies, a dropped conjunct weakens a VALUE that may flow
+        into non-monotone uses (count(xs) == 0, not f(x), equality), so
+        the whole enclosing statement/call must drop (or the compile
+        falls back / retries as a coarser screen) instead."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def barrier():
+            self._no_inv_catch += 1
+            try:
+                yield
+            finally:
+                self._no_inv_catch -= 1
+
+        return barrier()
+
     def _eval_not(self, inner: A.Expr, st: State) -> List[State]:
         sub = State(env=dict(st.env), space=st.space, guards=dict(st.guards), axis_owner=dict(st.axis_owner))
-        finals = self._eval_body([inner], sub)
+        with self._inv_barrier():
+            finals = self._eval_body([inner], sub)
         if not finals:
             return [st]  # statically undefined -> `not` succeeds
         exprs = []
@@ -577,6 +669,12 @@ class Compiler:
         if isinstance(term, A.BinOp):
             return self._eval_binop(term, st)
         if isinstance(term, A.Comprehension):
+            if self.screen_mode:
+                try:
+                    return self._eval_comprehension(term, st)
+                except (CompileUnsupported, InventoryDependent):
+                    self.uses_inventory = True
+                    return [(SInventory(), st)]
             return self._eval_comprehension(term, st)
         if isinstance(term, A.ArrayTerm):
             return self._eval_seq_literal(term.items, st, "array")
@@ -663,7 +761,17 @@ class Compiler:
         if name in self.rules:
             return self._eval_rule_ref(name, ref.ops, st)
         if name == "data":
-            raise CompileUnsupported("data ref (inventory) not compiled yet")
+            if (
+                ref.ops
+                and isinstance(ref.ops[0], A.Scalar)
+                and ref.ops[0].value == "inventory"
+            ):
+                # inventory joins compile as screens: the value is opaque
+                # and conditions on it drop (InventoryDependent); walking
+                # with unbound vars binds them opaquely too
+                self.uses_inventory = True
+                return self._walk(SInventory(), ref.ops[1:], st)
+            raise CompileUnsupported("data ref outside inventory")
         raise CompileUnsupported(f"unknown ref head {name}")
 
     def _walk(self, val: SVal, ops: List[A.Term], st: State):
@@ -678,6 +786,14 @@ class Compiler:
         return forks
 
     def _walk_one(self, val: SVal, op: A.Term, st: State):
+        if isinstance(val, SInventory):
+            # any step stays opaque; unbound var keys (ns/name/apiversion
+            # iteration) bind opaquely
+            if isinstance(op, A.Var) and op.name not in st.env:
+                env = dict(st.env)
+                env[op.name] = SInventory()
+                return [(SInventory(), replace(st, env=env))]
+            return [(SInventory(), st)]
         if isinstance(val, SInput):
             if isinstance(op, A.Scalar) and op.value == "parameters":
                 return [(SConst(self.params), st)]
@@ -925,7 +1041,8 @@ class Compiler:
                     # computed complete rule (requiredprobes' probe_type_set):
                     # compile only when the body resolves concretely
                     sub = State(env={})
-                    finals = self._eval_body(rule.body, sub)
+                    with self._inv_barrier():
+                        finals = self._eval_body(rule.body, sub)
                     if len(finals) != 1 or finals[0].cond or finals[0].space:
                         raise CompileUnsupported("computed complete rule")
                     forks = self._eval_term(rule.head.value, finals[0])
@@ -982,7 +1099,8 @@ class Compiler:
             raise CompileUnsupported("partial-set operand shape")
 
         sub = State(env=pre_env, space=st.space, guards=dict(st.guards), axis_owner=dict(st.axis_owner))
-        finals = self._eval_body(rule.body, sub)
+        with self._inv_barrier():
+            finals = self._eval_body(rule.body, sub)
         out = []
         for f in finals:
             for hv, hs in self._eval_term(rule.head.key, f):
@@ -1028,6 +1146,23 @@ class Compiler:
         return out
 
     def _apply_call(self, name: str, args: List[SVal], st: State):
+        if any(isinstance(a, SInventory) for a in args):
+            # calls over inventory values (identical(), flatten_selector,
+            # re_match on an iterated apiversion, sprintf into the msg)
+            # produce opaque values; conditions on them drop later
+            return [(SInventory(), st)]
+        if self.screen_mode:
+            try:
+                return self._apply_call_inner(name, args, st)
+            except (CompileUnsupported, InventoryDependent):
+                # InventoryDependent escaping a function body (via the
+                # _inv_barrier) means the call's value depends on
+                # inventory content: opaque, conditions on it drop
+                self.uses_inventory = True
+                return [(SInventory(), st)]
+        return self._apply_call_inner(name, args, st)
+
+    def _apply_call_inner(self, name: str, args: List[SVal], st: State):
         if name in self.rules:
             return self._inline_function(name, args, st)
         handler = getattr(self, f"_builtin_{name.replace('.', '_')}", None)
@@ -1097,7 +1232,8 @@ class Compiler:
                         raise CompileUnsupported("formal pattern shape")
                 if not ok:
                     continue
-                finals = self._eval_body(rule.body, sub)
+                with self._inv_barrier():
+                    finals = self._eval_body(rule.body, sub)
                 for f in finals:
                     vf = (
                         self._eval_term(rule.head.value, f)
@@ -1130,10 +1266,29 @@ class Compiler:
         if not self._fn_arg_scalar(name):
             return None
         oracle = self.cenv.oracle_fn
+        ns = self.cenv.oracle_ns
+        reads_params = self._fn_reads_params(name, set())
+        if self.cenv.oracle_ns_shared and not reads_params:
+            ns = self.cenv.oracle_ns_shared
+        # content hash over the whole module rule set: any template edit
+        # invalidates the persisted oracle memo (conservatively)
+        if not hasattr(self, "_rules_hash"):
+            import hashlib
+
+            self._rules_hash = hashlib.sha256(
+                repr(sorted((k, repr(v)) for k, v in self.rules.items()))
+                .encode()
+            ).hexdigest()
+        # ORACLE_MEMO_VERSION salts the key so oracle/interpreter
+        # implementation changes invalidate persisted memos
+        persist_key = f"v{ORACLE_MEMO_VERSION}|{self._rules_hash}|{name}"
+        if reads_params:
+            persist_key += f"|{json.dumps(self.params, sort_keys=True, default=str)}"
         tname = self.tables.register(
-            f"fn:{self.cenv.oracle_ns}:{name}",
+            f"fn:{ns}:{name}",
             lambda v, _n=name, _o=oracle: _numeric_oracle(_o, _n, v),
             dtype="float64",
+            persist_key=persist_key,
         )
         self.signature.append(("table", tname))
         if isinstance(arg, SScalar):
@@ -1179,6 +1334,49 @@ class Compiler:
             if bad:
                 return False
         return True
+
+    def _fn_reads_params(self, name: str, seen: set) -> bool:
+        """True if the function's call graph touches input.parameters
+        (then its table must stay per-params)."""
+        if name in seen:
+            return False
+        seen.add(name)
+        reads = []
+
+        def visit(node):
+            if (
+                isinstance(node, A.Ref)
+                and isinstance(node.head, A.Var)
+                and node.head.name == "input"
+            ):
+                reads.append("input")
+            if isinstance(node, A.Call):
+                base = (
+                    node.name.split(".")[-1] if "." in node.name
+                    else node.name
+                )
+                if base in self.rules and self._fn_reads_params(base, seen):
+                    reads.append(base)
+            if isinstance(node, A.Ref) and isinstance(node.head, A.Var):
+                if node.head.name in self.rules and self._fn_reads_params(
+                    node.head.name, seen
+                ):
+                    reads.append(node.head.name)
+
+        import dataclasses as _dc
+
+        def walk(n):
+            if isinstance(n, A.Node):
+                visit(n)
+                for f in _dc.fields(n):
+                    walk(getattr(n, f.name))
+            elif isinstance(n, (list, tuple)):
+                for x in n:
+                    walk(x)
+
+        for rule in self.rules.get(name, []):
+            walk(rule)
+        return bool(reads)
 
     def _fn_is_pure(self, name: str, seen: set) -> bool:
         """No input.review / data refs anywhere in the call graph
@@ -1238,6 +1436,8 @@ class Compiler:
         return out
 
     def _apply_binop(self, op: str, lv: SVal, rv: SVal, st: State):
+        if isinstance(lv, SInventory) or isinstance(rv, SInventory):
+            raise InventoryDependent()
         if isinstance(lv, SConst) and isinstance(rv, SConst):
             return self._const_binop(op, lv, rv, st)
         if op in ("==", "!=", "<", "<=", ">", ">="):
@@ -1506,6 +1706,8 @@ class Compiler:
         return out
 
     def _truthiness(self, v: SVal, st: State):
+        if isinstance(v, SInventory):
+            raise InventoryDependent()
         if isinstance(v, SConst):
             return True if v.value is not False else None
         if isinstance(v, SBool):
@@ -1553,7 +1755,8 @@ class Compiler:
         if term.kind == "object":
             raise CompileUnsupported("object comprehension")
         sub = State(env=dict(st.env), space=st.space, guards=dict(st.guards), axis_owner=dict(st.axis_owner))
-        finals = self._eval_body(term.body, sub)
+        with self._inv_barrier():
+            finals = self._eval_body(term.body, sub)
         if not finals:
             if term.kind == "set":
                 return [(SConst(set()), st)]

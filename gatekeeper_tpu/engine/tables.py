@@ -28,6 +28,12 @@ class _Table:
     dtype: Any
     values: np.ndarray
     defined: np.ndarray
+    # content-addressed persistence (oracle tables): entries computed by
+    # the interpreter oracle memoize across processes — SURVEY §5's
+    # "compiled rule tensors are a cache keyed on template hash"
+    persist_key: Optional[str] = None
+    persist_store: Optional[Dict[str, Tuple[Any, bool]]] = None
+    persist_new: int = 0
 
 
 class StrTables:
@@ -41,18 +47,26 @@ class StrTables:
         name: str,
         fn: Callable[[Any], Tuple[Any, bool]],
         dtype=np.float32,
+        persist_key: Optional[str] = None,
     ) -> str:
         """Idempotent by name. fn receives the decoded scalar VALUE of each
         vocab entry — a str for "s:" entries, the parsed JSON scalar
-        (number/bool/null) for "j:" entries; path entries are skipped."""
+        (number/bool/null) for "j:" entries; path entries are skipped.
+
+        `persist_key`: content hash enabling a cross-process disk
+        memo of fn results (for expensive interpreter-oracle fns)."""
         if name not in self._tables:
-            self._tables[name] = _Table(
+            t = _Table(
                 fn=fn,
                 dtype=dtype,
                 values=np.zeros((0,), dtype),
                 defined=np.zeros((0,), bool),
+                persist_key=persist_key,
             )
-            self._fill(self._tables[name])
+            if persist_key is not None:
+                t.persist_store = _load_persist(persist_key)
+            self._tables[name] = t
+            self._fill(t)
             self.generation += 1
         return name
 
@@ -65,10 +79,20 @@ class StrTables:
         defined = np.zeros((n,), bool)
         vals[:start] = t.values
         defined[:start] = t.defined
+        store = t.persist_store
         for i in range(start, n):
-            val = _decode_entry(self.vocab.string(i))
+            raw = self.vocab.string(i)
+            val = _decode_entry(raw)
             if val is _SKIP:
                 continue
+            if store is not None:
+                hit = store.get(raw)
+                if hit is not None:
+                    v, d = hit
+                    if d:
+                        vals[i] = v
+                        defined[i] = True
+                    continue
             try:
                 v, d = t.fn(val)
             except Exception:
@@ -76,8 +100,14 @@ class StrTables:
             if d:
                 vals[i] = v
                 defined[i] = True
+            if store is not None:
+                store[raw] = (v if d else 0, d)
+                t.persist_new += 1
         t.values = vals
         t.defined = defined
+        if t.persist_key is not None and t.persist_new >= 1024:
+            _save_persist(t.persist_key, t.persist_store)
+            t.persist_new = 0
 
     def sync(self) -> None:
         """Extend tables to cover the vocab; loops to a fixed point since
@@ -97,6 +127,12 @@ class StrTables:
                 break
         if changed:
             self.generation += 1
+        # flush pending memo entries even when this sync had nothing to
+        # extend (register()'s immediate fill may have produced them)
+        for t in self._tables.values():
+            if t.persist_key is not None and t.persist_new:
+                _save_persist(t.persist_key, t.persist_store)
+                t.persist_new = 0
 
     def arrays(self) -> Dict[str, np.ndarray]:
         """name -> values table, name+"!def" -> defined table."""
@@ -160,6 +196,68 @@ class StrTables:
 
 
 _SKIP = object()
+
+
+def _persist_dir() -> Optional[str]:
+    import os
+
+    if os.environ.get("GATEKEEPER_TPU_NO_COMPILE_CACHE") == "1":
+        return None
+    return os.environ.get(
+        "GATEKEEPER_TPU_ORACLE_CACHE_DIR",
+        os.path.expanduser("~/.cache/gatekeeper_tpu/oracle_tables"),
+    )
+
+
+def _persist_path(key: str) -> Optional[str]:
+    import hashlib
+    import os
+
+    d = _persist_dir()
+    if d is None:
+        return None
+    return os.path.join(d, hashlib.sha256(key.encode()).hexdigest() + ".npz")
+
+
+def _load_persist(key: str) -> Dict[str, Tuple[Any, bool]]:
+    path = _persist_path(key)
+    if path is None:
+        return {}
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            strings = z["strings"]
+            values = z["values"]
+            defined = z["defined"]
+        return {
+            str(s): (float(v), bool(d))
+            for s, v, d in zip(strings, values, defined)
+        }
+    except Exception:
+        return {}
+
+
+def _save_persist(key: str, store: Dict[str, Tuple[Any, bool]]) -> None:
+    path = _persist_path(key)
+    if path is None or not store:
+        return
+    import os
+    import tempfile
+
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        strings = np.array(list(store.keys()))
+        values = np.array([float(v) for v, _ in store.values()], np.float64)
+        defined = np.array([d for _, d in store.values()], bool)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+        os.close(fd)
+        np.savez_compressed(
+            tmp, strings=strings, values=values, defined=defined
+        )
+        # savez appends .npz to names lacking it
+        os.replace(tmp + ".npz", path)
+        os.unlink(tmp)
+    except Exception:
+        pass  # persistence is an optimization; never fail the fill
 
 
 def _decode_entry(s: str):

@@ -6,6 +6,7 @@ the micro-batching bridge that coalesces concurrent AdmissionReviews
 into one fused device dispatch (SURVEY §2.4 row 3).
 """
 
-from .policy import AdmissionResponse, ValidationHandler  # noqa: F401
+from .policy import AdmissionResponse, TraceConfig, ValidationHandler  # noqa: F401
+from .certs import CertRotator  # noqa: F401
 from .namespacelabel import IGNORE_LABEL, NamespaceLabelHandler  # noqa: F401
 from .server import MicroBatcher, WebhookServer  # noqa: F401

@@ -19,6 +19,7 @@ Behavioral mirror of pkg/webhook/policy.go's validationHandler.Handle
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -30,6 +31,47 @@ SERVICE_ACCOUNT_NAMESPACE = "gatekeeper-system"
 SERVICE_ACCOUNT = (
     f"system:serviceaccount:{SERVICE_ACCOUNT_NAMESPACE}:gatekeeper-admin"
 )
+
+
+class TraceConfig:
+    """Runtime per-request tracing rules from the Config CRD's
+    spec.validation.traces (config_types.go:39-51), consulted per
+    request by tracingLevel (policy.go:387-408): a request traces when
+    BOTH its user and GVK match a rule; dump: "All" additionally dumps
+    the whole engine state. Reconciled live by the config controller."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._traces: List[dict] = []
+
+    def replace(self, traces: List[dict]) -> None:
+        with self._lock:
+            self._traces = [t for t in (traces or []) if isinstance(t, dict)]
+
+    def level(self, request: Dict[str, Any]) -> tuple:
+        """-> (trace_enabled, dump)."""
+        user = (request.get("userInfo") or {}).get("username", "")
+        kind = request.get("kind") or {}
+        gvk = (
+            kind.get("group", ""),
+            kind.get("version", ""),
+            kind.get("kind", ""),
+        )
+        enabled = dump = False
+        with self._lock:
+            for t in self._traces:
+                if t.get("user") != user:
+                    continue
+                tk = t.get("kind") or {}
+                if (
+                    tk.get("group", ""),
+                    tk.get("version", ""),
+                    tk.get("kind", ""),
+                ) == gvk:
+                    enabled = True
+                    if str(t.get("dump", "")).lower() == "all":
+                        dump = True
+        return enabled, dump
 
 
 @dataclass
@@ -59,6 +101,10 @@ class ValidationHandler:
         namespace_getter: Optional[Callable[[str], Optional[dict]]] = None,
         log_denies: bool = False,
         metrics=None,
+        trace_config: Optional[TraceConfig] = None,
+        event_sink: Optional[Callable[[Dict[str, Any]], None]] = None,
+        emit_admission_events: bool = False,
+        trace_log: Optional[Callable[[str], None]] = None,
     ):
         self.client = client
         self.target = target
@@ -66,7 +112,14 @@ class ValidationHandler:
         self.namespace_getter = namespace_getter
         self.log_denies = log_denies
         self.metrics = metrics
+        self.trace_config = trace_config
+        # violation event emission (--emit-admission-events,
+        # policy.go:253-273); the sink is the K8s Events stand-in
+        self.event_sink = event_sink
+        self.emit_admission_events = emit_admission_events
+        self.trace_log = trace_log
         self.denied_log: List[Dict[str, Any]] = []
+        self.traces: List[str] = []  # captured per-request traces
 
     # -- entry ---------------------------------------------------------------
 
@@ -112,10 +165,15 @@ class ValidationHandler:
                 True, "Namespace is set to be ignored by Gatekeeper config"
             )
 
+        trace_enabled = dump = False
+        if self.trace_config is not None:
+            trace_enabled, dump = self.trace_config.level(request)
         try:
-            results = self._review(request)
+            results = self._review(request, tracing=trace_enabled)
         except Exception as e:
             return AdmissionResponse(False, str(e), code=500)
+        if dump:
+            self._emit_trace(self.client.dump())
 
         msgs = self._deny_messages(results, request)
         if msgs:
@@ -124,10 +182,19 @@ class ValidationHandler:
 
     # -- pieces --------------------------------------------------------------
 
-    def _review(self, request: Dict[str, Any]) -> List[Any]:
+    def _emit_trace(self, text: str) -> None:
+        self.traces.append(text)
+        if self.trace_log is not None:
+            self.trace_log(text)
+
+    def _review(
+        self, request: Dict[str, Any], tracing: bool = False
+    ) -> List[Any]:
         review = self._augment(request)
-        responses = self.client.review(review)
+        responses = self.client.review(review, tracing=tracing)
         resp = responses.by_target.get(self.target)
+        if tracing and resp is not None and resp.trace:
+            self._emit_trace(resp.trace)
         return resp.results if resp is not None else []
 
     def _augment(self, request: Dict[str, Any]) -> AugmentedReview:
@@ -158,6 +225,36 @@ class ValidationHandler:
                         "resource_namespace": request.get("namespace", ""),
                         "resource_name": request.get("name", ""),
                         "msg": r.msg,
+                    }
+                )
+            if (
+                r.enforcement_action in ("deny", "dryrun")
+                and self.emit_admission_events
+                and self.event_sink is not None
+            ):
+                dryrun = r.enforcement_action == "dryrun"
+                self.event_sink(
+                    {
+                        "type": "Warning",
+                        "reason": (
+                            "DryrunViolation" if dryrun else "FailedAdmission"
+                        ),
+                        "process": "admission",
+                        "event_type": "violation",
+                        "constraint_name": cname,
+                        "constraint_kind": (r.constraint or {}).get(
+                            "kind", ""
+                        ),
+                        "constraint_action": r.enforcement_action,
+                        "resource_kind": (request.get("kind") or {}).get(
+                            "kind", ""
+                        ),
+                        "resource_namespace": request.get("namespace", ""),
+                        "resource_name": request.get("name", ""),
+                        "request_username": (
+                            request.get("userInfo") or {}
+                        ).get("username", ""),
+                        "message": r.msg,
                     }
                 )
             if r.enforcement_action == "deny":

@@ -197,7 +197,13 @@ class BatchedValidationHandler(ValidationHandler):
         self.batcher = batcher
         self.request_timeout = request_timeout
 
-    def _review(self, request: Dict[str, Any]) -> List[Any]:
+    def _review(
+        self, request: Dict[str, Any], tracing: bool = False
+    ) -> List[Any]:
+        if tracing:
+            # traced requests bypass the batcher: traces are per-request
+            # by definition (the driver's batched path declines tracing)
+            return super()._review(request, tracing=True)
         return self.batcher.submit(request).result(
             timeout=self.request_timeout
         )
@@ -222,6 +228,10 @@ class WebhookServer:
         tls: bool = False,
         cert_dir: Optional[str] = None,
         request_timeout: float = DEFAULT_REQUEST_TIMEOUT,
+        trace_config=None,
+        event_sink=None,
+        emit_admission_events: bool = False,
+        log_denies: bool = False,
     ):
         self.batcher = MicroBatcher(
             client, target, window_ms=window_ms,
@@ -230,6 +240,10 @@ class WebhookServer:
         self.handler = BatchedValidationHandler(
             self.batcher, excluder=excluder, metrics=metrics,
             request_timeout=request_timeout,
+            trace_config=trace_config,
+            event_sink=event_sink,
+            emit_admission_events=emit_admission_events,
+            log_denies=log_denies,
         )
         self.label_handler = NamespaceLabelHandler(exempt_namespaces)
         outer = self
@@ -303,7 +317,10 @@ class WebhookServer:
         is this engine's equivalent). Returns seconds spent."""
         t0 = time.monotonic()
         if sample_objects is None:
-            sample_objects = [_warm_pod(1), _warm_pod(8)]
+            # vary label counts so both feature-shape buckets warm
+            sample_objects = [
+                _warm_pod(1 + (i % 2) * 7) for i in range(192)
+            ]
         reviews = []
         for i, obj in enumerate(sample_objects):
             reviews.append(
@@ -324,9 +341,12 @@ class WebhookServer:
                 )
             )
         try:
-            # one single-review batch and one multi-review batch cover
-            # the common occupancy buckets (rows bucket at 64)
-            self.client.review_many(reviews[:1])
+            # device-sized batches covering the common occupancy
+            # buckets (row counts bucket at 64/128/256; sub-device-
+            # threshold batches route to the interpreter and need no
+            # compile)
+            self.client.review_many(reviews[:16])
+            self.client.review_many(reviews[:100])
             self.client.review_many(reviews)
         except Exception:
             pass  # warmup is best-effort; serving still works unwarmed

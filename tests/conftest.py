@@ -13,6 +13,10 @@ import sys
 # JAX_PLATFORMS=axon, and tests must run on the deterministic local
 # 8-device CPU mesh (the real chip is exercised by bench.py / the driver)
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# tests must exercise the real oracle/compile paths, not warm disk
+# memos — and must not pollute the user-level cache dirs
+os.environ["GATEKEEPER_TPU_NO_COMPILE_CACHE"] = "1"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

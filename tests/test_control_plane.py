@@ -414,3 +414,130 @@ def test_boot_from_yaml_dir(tmp_path):
         "victim"
     )
     runner.stop()
+
+
+def test_trace_config_reconciled_and_applied(booted):
+    """Config spec.validation.traces flips per-request tracing at
+    runtime (config_types.go:39-51; policy.go:387-408)."""
+    cluster, runner = booted
+    cfg = config()
+    cfg["spec"]["validation"] = {
+        "traces": [
+            {
+                "user": "auditor",
+                "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            }
+        ]
+    }
+    cluster.apply(cfg)
+    runner.watch_mgr.wait_idle()
+    h = runner.webhook.handler
+
+    req = {
+        "uid": "t1",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": "CREATE",
+        "name": "traced",
+        "namespace": "default",
+        "userInfo": {"username": "auditor"},
+        "object": pod("traced"),
+    }
+    before = len(h.traces)
+    h.handle(req)
+    assert len(h.traces) > before  # matched rule -> trace captured
+    assert "eval" in h.traces[-1] or "tpu" in h.traces[-1]
+
+    other = dict(req, userInfo={"username": "someone-else"})
+    before = len(h.traces)
+    h.handle(other)
+    assert len(h.traces) == before  # non-matching user -> no trace
+
+
+def test_admission_and_audit_events_emitted():
+    cluster = FakeCluster()
+    cluster.apply(template("K8sRequiredLabels", REQ_LABELS))
+    cluster.apply(
+        constraint(
+            "K8sRequiredLabels", "need-owner", params={"labels": ["owner"]}
+        )
+    )
+    cluster.apply(config())
+    cluster.apply(pod("bad"))
+    runner = make_runner(
+        cluster, emit_admission_events=True, emit_audit_events=True
+    )
+    runner.start()
+    assert runner.wait_ready(30)
+    try:
+        resp = runner.webhook.handler.handle(
+            {
+                "uid": "e1",
+                "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "operation": "CREATE",
+                "name": "evpod",
+                "namespace": "default",
+                "userInfo": {"username": "dev"},
+                "object": pod("evpod"),
+            }
+        )
+        assert resp.allowed is False
+        admission_events = [
+            e for e in runner.events if e["reason"] == "FailedAdmission"
+        ]
+        assert admission_events and admission_events[0]["resource_name"] == (
+            "evpod"
+        )
+
+        runner.audit.audit()
+        audit_events = [
+            e for e in runner.events if e["reason"] == "AuditViolation"
+        ]
+        assert audit_events and audit_events[0]["resource_name"] == "bad"
+    finally:
+        runner.stop()
+
+
+def test_config_edit_with_unchanged_syncset_keeps_data(booted):
+    """A Config change that does NOT alter syncOnly (e.g. only match or
+    traces edited) must still leave synced data intact: the wipe that
+    precedes the watch swap has to be followed by a replay of EVERY GVK
+    in the new set, retained ones included (config_controller.go:294)."""
+    cluster, runner = booted
+    assert audit_results(runner).total_violations == 1
+    cluster.apply(
+        config(
+            match=[{"processes": ["webhook"],
+                    "excludedNamespaces": ["kube-system"]}]
+        )
+    )
+    runner.watch_mgr.wait_idle()
+    # the pod data survived the wipe via replay
+    assert audit_results(runner).total_violations == 1
+
+
+def test_excluded_data_does_not_wedge_readiness():
+    """An object listed at boot but excluded by the Config's sync match
+    must not hold /readyz at 503 (the sink cancels its expectation)."""
+    cluster = FakeCluster()
+    cluster.apply(template("K8sRequiredLabels", REQ_LABELS))
+    cluster.apply(
+        constraint(
+            "K8sRequiredLabels", "need-owner", params={"labels": ["owner"]}
+        )
+    )
+    cluster.apply(
+        config(
+            match=[{"processes": ["sync"],
+                    "excludedNamespaces": ["kube-system"]}]
+        )
+    )
+    cluster.apply(pod("sys", ns="kube-system"))
+    cluster.apply(pod("normal"))
+    runner = make_runner(cluster)
+    runner.start()
+    try:
+        assert runner.wait_ready(30), runner.tracker.stats()
+        # the excluded pod was not ingested
+        assert audit_results(runner).total_violations == 1  # only "normal"
+    finally:
+        runner.stop()

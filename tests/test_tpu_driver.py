@@ -281,13 +281,15 @@ def test_fanout_over_8_containers(clients):
 
 
 def test_unsupported_template_routes_to_interpreter():
-    """A template outside the compilable subset must be evaluated by the
-    interpreter fallback, not dropped (hybrid routing per SURVEY §7)."""
+    """An inventory-join template compiles as a SCREEN: the device path
+    flags candidate reviews and the interpreter renders exact results
+    for them (hybrid routing per SURVEY §7; screens per
+    symbolic.InventoryDependent)."""
     drv = TpuDriver()
     backend = Backend(drv)
     client = backend.new_client(K8sValidationTarget())
-    # uniqueingresshost requires data.inventory joins — the hard case the
-    # compiler does not support yet
+    # uniqueingresshost requires data.inventory joins — compiled as an
+    # over-approximating screen, exact results via interpreter re-check
     client.add_template(load_template(f"{LIB}/general/uniqueingresshost"))
     client.add_constraint(
         make_constraint("K8sUniqueIngressHost", "unique-host")
@@ -318,7 +320,8 @@ def test_unsupported_template_routes_to_interpreter():
     )
     assert len(results) == 1
     assert "conflicts" in results[0].msg
-    assert drv.stats["interp_pairs"] > 0
+    # the screen keeps the template ON the compiled path
+    assert drv.stats["compiled_pairs"] > 0
 
     # oracle cross-check
     rego_client = Backend(RegoDriver()).new_client(K8sValidationTarget())
@@ -350,3 +353,62 @@ def test_datastore_unescapes_path_segments():
     assert list(tree) == ["extensions/v1beta1"]
     ds.put("/x/bad%zzseg", 7)
     assert ds.get(["x", "bad%zzseg"], None) == 7
+
+
+def test_inventory_join_screens_exact_parity():
+    """Both data.inventory templates ride the compiled (screen) path and
+    produce bit-exact audit/review results vs the interpreter driver."""
+
+    def build(driver):
+        client = Backend(driver).new_client(K8sValidationTarget())
+        client.add_template(
+            load_template(f"{LIB}/general/uniqueingresshost")
+        )
+        client.add_template(
+            load_template(f"{LIB}/general/uniqueserviceselector")
+        )
+        client.add_constraint(
+            make_constraint("K8sUniqueIngressHost", "unique-host")
+        )
+        client.add_constraint(
+            make_constraint("K8sUniqueServiceSelector", "unique-sel")
+        )
+
+        def ing(name, ns, host):
+            return {
+                "apiVersion": "networking.k8s.io/v1beta1",
+                "kind": "Ingress",
+                "metadata": {"name": name, "namespace": ns},
+                "spec": {"rules": [{"host": host}]},
+            }
+
+        def svc(name, ns, sel):
+            return {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {"name": name, "namespace": ns},
+                "spec": {"selector": sel},
+            }
+
+        for obj in [
+            ing("a", "ns1", "x.example.com"),
+            ing("b", "ns2", "x.example.com"),  # conflicts with a
+            ing("c", "ns1", "unique.example.com"),
+            svc("s1", "ns1", {"app": "web", "tier": "fe"}),
+            svc("s2", "ns1", {"tier": "fe", "app": "web"}),  # same sel
+            svc("s3", "ns1", {"app": "db"}),
+            pod("p1"),
+        ]:
+            client.add_data(obj)
+        return client
+
+    tpu_drv = TpuDriver()
+    tpu_client = build(tpu_drv)
+    rego_client = build(RegoDriver())
+    got = canon(tpu_client.audit().by_target[TARGET].results)
+    want = canon(rego_client.audit().by_target[TARGET].results)
+    assert got == want
+    assert len(want) == 4  # 2 ingress conflicts + 2 service conflicts
+    # both templates compiled (as screens), none fell back wholesale
+    cs = tpu_drv._cset[TARGET]
+    assert all(p is not None and p.screen for p in cs.programs)
