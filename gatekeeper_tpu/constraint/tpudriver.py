@@ -134,6 +134,10 @@ class _Corpus:
     # [(start, StagedBatch)] device-resident chunks; staged lazily at
     # first dispatch, reused every sweep until the corpus changes
     staged: Optional[List[Tuple[int, Any]]] = None
+    # computed per-row screen features (invdup join bits), host copies
+    row_feats: Optional[Dict[str, np.ndarray]] = None
+    # per-pattern join-key value counts (pid -> (counts, has_fallback))
+    value_counts: Optional[Dict[int, Any]] = None
 
 
 @dataclass
@@ -416,7 +420,7 @@ class TpuDriver(RegoDriver):
         return corpus.staged
 
     def _need_pairs(
-        self, cs: _ConstraintSet, corpus: _Corpus
+        self, target: str, cs: _ConstraintSet, corpus: _Corpus
     ) -> Tuple[List[Tuple[int, int]], int, int]:
         """Sparse evaluation: -> (review-major (n, c) pairs needing
         interpreter work, compiled_pairs, interp_pairs)."""
@@ -426,6 +430,17 @@ class TpuDriver(RegoDriver):
         from ..parallel.sharding import decode_need
 
         stacked = self._stage_corpus(corpus)
+        needed = sorted(
+            {
+                f
+                for p in cs.programs
+                if p is not None
+                for f in p.row_features
+            }
+        )
+        if needed:
+            feats = self._row_feature_bits(target, corpus, needed)
+            self.kernel.stage_row_feats(stacked, feats)
         # the whole sweep: one device execution, one fetch
         packed, hot, n_hot, sc, si = self.kernel.dispatch_need_all(
             policy, stacked, corpus.g
@@ -448,6 +463,98 @@ class TpuDriver(RegoDriver):
                 )
             pairs.extend(zip((start + n_loc).tolist(), c_is.tolist()))
         return pairs, stat_c, stat_i
+
+    def _row_feature_bits(
+        self, target: str, corpus: _Corpus, names: List[str]
+    ) -> Dict[str, np.ndarray]:
+        """Per-row screen refinement bits for inventory join templates.
+
+        "invdup:<pattern>" semantics (sound over-approximations of the
+        uniqueness-join truth):
+          * persistent audit corpus (reviews ARE the inventory): the
+            row holds a value at <pattern> carried by >=2 distinct rows
+            — a key carried only by its own object can never conflict
+            (the identical() exclusion);
+          * ephemeral review batch (webhook): the row holds a value at
+            <pattern> present ANYWHERE in the synced inventory (the
+            identical() exclusion is re-checked exactly by the
+            interpreter render).
+        """
+        if corpus.row_feats is None:
+            corpus.row_feats = {}
+        out: Dict[str, np.ndarray] = {}
+        for name in names:
+            cached = corpus.row_feats.get(name)
+            if cached is not None:
+                out[name] = cached
+                continue
+            pid = int(name.split(":", 1)[1])
+            base = corpus
+            if corpus.data_gen >= 0:
+                counts, inv_fb = self._pattern_value_counts(corpus, pid)
+                # a fallback (token-overflow) row's keys are invisible
+                # to the counts: its partner would see count 1 — drop
+                # the threshold so single-count carriers still route
+                thresh = 1 if inv_fb else 2
+            else:
+                with_inv = self._audit_corpus(target)
+                if with_inv is None:
+                    counts, inv_fb = None, False
+                else:
+                    counts, inv_fb = self._pattern_value_counts(
+                        with_inv, pid
+                    )
+                thresh = 1
+            sel, vids = self._pattern_tokens(base, pid)
+            if counts is None:
+                feat = np.zeros(len(base.reviews), bool)
+            elif inv_fb and corpus.data_gen < 0:
+                # inventory keys partially invisible: reviews cannot be
+                # screened against it — route everything (coarse, sound)
+                feat = np.ones(len(base.reviews), bool)
+            else:
+                dup = counts >= thresh
+                safe = np.minimum(np.maximum(vids, 0), dup.shape[0] - 1)
+                hit = sel & (vids >= 0) & (vids < dup.shape[0]) & dup[safe]
+                feat = hit.any(axis=1)
+            # fallback rows (overflow etc.) must stay routed
+            feat |= np.asarray(base.row_fallback, bool)
+            corpus.row_feats[name] = feat
+            out[name] = feat
+        return out
+
+    def _pattern_tokens(self, corpus: _Corpus, pid: int):
+        member = np.asarray(self.patterns.member)
+        spath = corpus.tok["spath"]
+        vids = corpus.tok["vid"]
+        width = member.shape[1]
+        safe = np.minimum(np.maximum(spath, 0), max(width - 1, 0))
+        sel = (spath >= 0) & (spath < width) & member[pid][safe]
+        return sel, vids
+
+    def _pattern_value_counts(self, corpus: _Corpus, pid: int):
+        """-> ([V] int distinct-row counts per value id at tokens
+        matching pattern `pid`, any_fallback_rows). Cached on the corpus
+        (the ephemeral webhook path reuses the persistent inventory's
+        counts across requests)."""
+        if corpus.value_counts is None:
+            corpus.value_counts = {}
+        cached = corpus.value_counts.get(pid)
+        if cached is not None:
+            return cached
+        sel, vids = self._pattern_tokens(corpus, pid)
+        valid = sel & (vids >= 0)
+        rows, cols = np.nonzero(valid)
+        tv = vids[rows, cols]
+        if tv.size == 0:
+            counts = np.zeros((len(self.vocab),), np.int64)
+        else:
+            pairs = np.unique(rows.astype(np.int64) * (tv.max() + 1) + tv)
+            uniq_vids = pairs % (tv.max() + 1)
+            counts = np.bincount(uniq_vids, minlength=len(self.vocab))
+        result = (counts, bool(np.asarray(corpus.row_fallback).any()))
+        corpus.value_counts[pid] = result
+        return result
 
     def _redispatch_chunk(self, policy, corpus: _Corpus, stacked, ci: int,
                           n_hot: int):
@@ -636,7 +743,7 @@ class TpuDriver(RegoDriver):
             c_count = len(cs.constraints)
             n_count = len(reviews)
             if self.use_jax:
-                pairs, stat_c, stat_i = self._need_pairs(cs, corpus)
+                pairs, stat_c, stat_i = self._need_pairs(target, cs, corpus)
             else:
                 pairs, stat_c, stat_i = self._need_pairs_np(
                     cs, corpus, ns_cache, n_count

@@ -59,6 +59,10 @@ class EvalCtx:
     slabs: Optional[Dict[str, Any]] = None
     # slab name -> {identifier: column index}
     slab_cols: Optional[Dict[str, Dict[Any, int]]] = None
+    # per-row feature arrays ([N] bool), e.g. inventory join-key
+    # duplication bits; ERowFeature reads them, defaulting to True
+    # (unrefined) when a caller supplies none
+    row: Optional[Dict[str, Any]] = None
 
     @property
     def n(self) -> int:
@@ -172,6 +176,23 @@ class EFullN(Expr):
         if isinstance(self.value, bool):
             return ctx.np.full((ctx.n,), self.value)
         return ctx.np.full((ctx.n,), self.value)
+
+
+@dataclass(eq=False)
+class ERowFeature(Expr):
+    """[N] bool feature supplied by the dispatch layer (e.g. the
+    inventory join-key duplication screen). Missing feature -> True
+    (the unrefined, coarser-but-sound screen)."""
+
+    name: str
+    space: Tuple[str, ...] = ()
+
+    def _emit(self, ctx):
+        if ctx.row is not None:
+            feat = ctx.row.get(self.name)
+            if feat is not None:
+                return feat
+        return ctx.np.full((ctx.n,), True)
 
 
 @dataclass(eq=False)

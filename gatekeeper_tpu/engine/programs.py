@@ -33,6 +33,9 @@ class Program:
     # dropped, symbolic.InventoryDependent); exact results come from the
     # interpreter re-check of flagged pairs
     screen: bool = False
+    # per-row feature names this program consumes ("invdup:<pattern>"
+    # join-key duplication bits the dispatch layer computes per corpus)
+    row_features: Tuple[str, ...] = ()
 
 
 def compile_program(
@@ -63,6 +66,7 @@ def compile_program(
         consts=comp.pool.values,
         signature=sig,
         screen=comp.uses_inventory,
+        row_features=tuple(comp.row_features),
     )
 
 

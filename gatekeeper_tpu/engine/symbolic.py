@@ -212,6 +212,10 @@ class SScalar(SVal):
     # transformed string values (lower/trim/set-element bindings): ids of
     # known-string entries, bypassing the token columns
     vid_override: Optional[Expr] = None
+    # render-signature override: derived values that stand in for a
+    # message (value-position sprintf) keep SMsg-style cross-clause
+    # dedup via _val_sig
+    msg_sig: Optional[Tuple] = None
 
     @property
     def space(self) -> Tuple[str, ...]:
@@ -309,6 +313,12 @@ class SMsg(SVal):
     """
 
     sig: Any = None
+    # single-symbolic-arg sprintf carries a LAZY transform recipe
+    # (fmt, arg): comparisons materialize it into an id-transform table
+    # on demand (apparmor's annotation-key join). Eager registration
+    # exploded: several message-position sprintf tables mutually
+    # transforming each other's products grow the vocab exponentially.
+    recipe: Optional[Tuple[str, Any]] = None
 
     def signature(self):
         return self.sig if self.sig is not None else ("opaque", id(self))
@@ -419,6 +429,17 @@ class Compiler:
         self.uses_inventory = False  # compiled as a screen (see
         # InventoryDependent): flagged pairs re-check via interpreter
         self._no_inv_catch = 0  # >0 inside negation bodies
+        # row-level safety flags: [N]-space bools OR'd into the clause
+        # being compiled when a construct is handled under a shape
+        # assumption (e.g. object-key iteration at a node that COULD
+        # hold an array) — rows breaking the assumption route to the
+        # interpreter instead of silently evaluating wrong
+        self._force_flags: List[Expr] = []
+        # pattern ids of review-side leaves equality-joined against
+        # inventory content in the clause being compiled (screen
+        # refinement; see _apply_binop)
+        self._clause_joins: List[int] = []
+        self.row_features: List[str] = []  # features programs consume
 
     def _pattern(self, segs: Tuple[str, ...]) -> int:
         idx = self.patterns.register(segs)
@@ -467,7 +488,35 @@ class Compiler:
     def _compile_clause(
         self, rule: A.Rule
     ) -> List[Tuple[Any, Tuple[str, ...], Expr]]:
+        flags_base = len(self._force_flags)
+        joins_base = len(self._clause_joins)
         finals = self._eval_body(rule.body, State(env={}))
+        # safety flags raised during this clause's evaluation OR into
+        # every branch: flagged rows always route to the interpreter
+        clause_flags = self._force_flags[flags_base:]
+        del self._force_flags[flags_base:]
+        # inventory join refinements AND into the clause: a row can only
+        # violate if SOME recorded join key is duplicated cluster-wide
+        # (the dispatch layer supplies the per-row bits; absent bits
+        # default True so the screen degrades to coarse, never unsound)
+        clause_joins = sorted(set(self._clause_joins[joins_base:]))
+        del self._clause_joins[joins_base:]
+        join_refine: Optional[Expr] = None
+        if clause_joins:
+            from .exprs import ERowFeature
+
+            for pid in clause_joins:
+                feat_name = f"invdup:{pid}"
+                if feat_name not in self.row_features:
+                    self.row_features.append(feat_name)
+                    self.signature.append(("rowfeat", feat_name))
+                f = ERowFeature(feat_name)
+                # ALL dropped equalities are conjuncts: clause truth
+                # implies every joined key is matched by another object,
+                # so ANDing the bits stays sound and is sharpest
+                join_refine = f if join_refine is None else e_and(
+                    join_refine, f
+                )
         outs: List[Tuple[Any, Tuple[str, ...], Expr]] = []
         for st in finals:
             # the head must evaluate too (undefined heads drop violations);
@@ -479,14 +528,32 @@ class Compiler:
                 # with a unique (no-dedup) signature — over-counting is
                 # fine for a screen, the interpreter renders exact sets
                 cond = self._conj(st)
+                if join_refine is not None:
+                    cond = e_and(cond, join_refine)
+                cond = self._with_flags(cond, clause_flags)
                 outs.append(
                     (("inv-head", id(rule), len(outs)), cond.space, cond)
                 )
                 continue
             for hv, hs in head_forks:
                 cond = self._conj(hs)
+                if join_refine is not None:
+                    cond = e_and(cond, join_refine)
+                cond = self._with_flags(cond, clause_flags)
                 outs.append((_freeze_sig(_val_sig(hv)), cond.space, cond))
+        if not outs and clause_flags:
+            # the clause compiled to statically-nothing but carries
+            # safety flags: flagged rows must still route
+            flag = clause_flags[0]
+            for f in clause_flags[1:]:
+                flag = e_or(flag, f)
+            outs.append((("flag-only", id(rule)), flag.space, flag))
         return outs
+
+    def _with_flags(self, cond: Expr, flags: List[Expr]) -> Expr:
+        for f in flags:
+            cond = e_or(cond, f)
+        return cond
 
     def _conj(self, st: State) -> Expr:
         # anchor to [N] so fully-concrete bodies still count per resource
@@ -556,6 +623,8 @@ class Compiler:
     def _eval_assign(self, target, value, st: State) -> List[State]:
         if isinstance(target, A.Wildcard):
             return self._eval_cond_term(value, st)
+        if isinstance(target, A.ArrayTerm):
+            return self._eval_destructure(target, value, st)
         if not isinstance(target, A.Var):
             raise CompileUnsupported("destructuring assignment")
         out = []
@@ -571,6 +640,91 @@ class Compiler:
                 )
             env = dict(st2.env)
             env[target.name] = val
+            out.append(replace(st2, env=env))
+        return out
+
+    def _eval_destructure(self, target: A.ArrayTerm, value, st: State):
+        """`[prefix, name] := split(key, "/")`-style array destructuring.
+
+        Supported value shapes: `split(sym, const_sep)` — each part
+        becomes an id-transform table (defined only when the split
+        yields exactly len(target) parts, matching Rego's unification
+        failure on length mismatch) — and SList/SConst sequences of
+        matching length."""
+        n = len(target.items)
+        vars_ = []
+        for t in target.items:
+            if isinstance(t, (A.Var, A.Wildcard)):
+                vars_.append(t)
+            else:
+                raise CompileUnsupported("destructure target shape")
+        if (
+            isinstance(value, A.Call)
+            and value.name == "split"
+            and len(value.args) == 2
+        ):
+            out = []
+            for sep_v, st1 in self._eval_term(value.args[1], st):
+                if not isinstance(sep_v, SConst) or not isinstance(
+                    sep_v.value, str
+                ):
+                    raise CompileUnsupported("split separator shape")
+                sep = sep_v.value
+                for tgt_v, st2 in self._eval_term(value.args[0], st1):
+                    tgt_v = self._leafify(tgt_v)
+                    if isinstance(tgt_v, SConst):
+                        if not isinstance(tgt_v.value, str):
+                            continue
+                        parts = tgt_v.value.split(sep)
+                        if len(parts) != n:
+                            continue
+                        env = dict(st2.env)
+                        for t, p in zip(vars_, parts):
+                            if isinstance(t, A.Var):
+                                env[t.name] = SConst(p)
+                        out.append(replace(st2, env=env))
+                        continue
+                    env = dict(st2.env)
+                    conds: List[Expr] = []
+                    for i, t in enumerate(vars_):
+                        def mk(sep=sep, i=i, n=n):
+                            def fn(s):
+                                parts = s.split(sep)
+                                if len(parts) != n:
+                                    raise ValueError("part count")
+                                return parts[i]
+
+                            return fn
+
+                        forks = self._str_transform(
+                            tgt_v, st2, f"split:{sep}:{i}of{n}", mk()
+                        )
+                        if not forks:
+                            return []
+                        part, _ = forks[0]
+                        conds.append(part.exists())
+                        if isinstance(t, A.Var):
+                            env[t.name] = part
+                    out.append(
+                        replace(st2, env=env, cond=st2.cond + conds)
+                    )
+            return out
+        forks = self._eval_term(value, st)
+        out = []
+        for val, st2 in forks:
+            items = None
+            if isinstance(val, SList) and len(val.items) == n:
+                items = [v for _, v in val.items]
+            elif isinstance(val, SConst) and isinstance(val.value, list) and (
+                len(val.value) == n
+            ):
+                items = [SConst(x) for x in val.value]
+            if items is None:
+                raise CompileUnsupported("destructure value shape")
+            env = dict(st2.env)
+            for t, v in zip(vars_, items):
+                if isinstance(t, A.Var):
+                    env[t.name] = v
             out.append(replace(st2, env=env))
         return out
 
@@ -958,6 +1112,7 @@ class Compiler:
         bind = op.name if isinstance(op, A.Var) else None
         forks: List[Tuple[SVal, State]] = []
         # array branch: extend with "#" (lazy axis)
+        axis_conflict = False
         if (
             node.prefix.count("#") < 2
             and "*" not in node.prefix
@@ -968,29 +1123,34 @@ class Compiler:
             axis = axes[-1]
             owner = st.axis_owner.get(axis)
             if owner is not None and owner != node.prefix:
-                raise CompileUnsupported(
-                    f"two arrays on one axis: {owner} vs {node.prefix}"
+                # a second array cannot share the open group axis — but
+                # the node may be an OBJECT (annotations under the
+                # containers axis, the seccomp/apparmor join): skip the
+                # array interpretation and let the object branch handle
+                # it, with a row-level safety flag for rows where the
+                # node actually holds an array (those route to the
+                # interpreter instead of evaluating wrong)
+                axis_conflict = True
+            else:
+                guard_pat = self._pattern(child.prefix + ("**",))
+                guard = EGroupPresent(ESelPattern(guard_pat), axis)
+                guards = dict(st.guards)
+                guards[axis] = guard
+                owners = dict(st.axis_owner)
+                owners[axis] = node.prefix
+                env = dict(st.env)
+                if bind:
+                    # the numeric index value: comparisons against it are
+                    # statically false (no library template uses it)
+                    env[bind] = SConst(_ARRAY_INDEX)
+                st2 = replace(
+                    st,
+                    env=env,
+                    space=_space_join(st.space, axes),
+                    guards=guards,
+                    axis_owner=owners,
                 )
-
-            guard_pat = self._pattern(child.prefix + ("**",))
-            guard = EGroupPresent(ESelPattern(guard_pat), axis)
-            guards = dict(st.guards)
-            guards[axis] = guard
-            owners = dict(st.axis_owner)
-            owners[axis] = node.prefix
-            env = dict(st.env)
-            if bind:
-                # the numeric index value: comparisons against it are
-                # statically false (no library template uses it)
-                env[bind] = SConst(_ARRAY_INDEX)
-            st2 = replace(
-                st,
-                env=env,
-                space=_space_join(st.space, axes),
-                guards=guards,
-                axis_owner=owners,
-            )
-            forks.append((child, st2))
+                forks.append((child, st2))
         # object branch: token axis over keys; allowed under an open array
         # axis too (joins land on the rank-3 ("tok","g0") space)
         if st.space in ((), ("g0",)):
@@ -1006,6 +1166,17 @@ class Compiler:
                 cond=st.cond + [scalar.truthy()],
             )
             forks.append((scalar, st2))
+            if axis_conflict:
+                # object-only handling of a maybe-array node: rows where
+                # it IS an array must route (Rego would bind indices
+                # there; "*" never matches the "#" marker so the object
+                # branch sees nothing — an under-approximation without
+                # this flag)
+                arr_pat = self._pattern(node.prefix + ("#", "**"))
+                self._force_flags.append(
+                    EReduce(ESelPattern(arr_pat), "any")
+                )
+                self.uses_inventory = True
         if not forks:
             if "tok" in st.space:
                 # we're inside the phantom object-branch of an earlier
@@ -1437,6 +1608,32 @@ class Compiler:
 
     def _apply_binop(self, op: str, lv: SVal, rv: SVal, st: State):
         if isinstance(lv, SInventory) or isinstance(rv, SInventory):
+            # equality joins between a review-side leaf and inventory
+            # content record the leaf's pattern: the dispatch layer then
+            # supplies a per-row "join key duplicated in the inventory"
+            # feature that SHARPENS the screen (rows whose keys are
+            # unique cluster-wide cannot violate a uniqueness join and
+            # need no interpreter re-check).
+            # The _no_inv_catch guard is load-bearing for soundness: it
+            # restricts recording to TOP-LEVEL clause conjuncts. Inside
+            # negations the join is anti-monotone, and inside function/
+            # rule/comprehension bodies the equality may sit in ONE of
+            # several definitions — ANDing the refinement into the whole
+            # clause would wrongly screen forks that can violate without
+            # the join (those constructs run under the _inv_barrier).
+            if op == "==" and self._no_inv_catch == 0:
+                other = rv if isinstance(lv, SInventory) else lv
+                try:
+                    leaf = self._leafify(other)
+                except CompileUnsupported:
+                    leaf = None
+                if (
+                    isinstance(leaf, SScalar)
+                    and leaf.pattern_idx >= 0
+                    and leaf.num_override is None
+                    and leaf.vid_override is None
+                ):
+                    self._clause_joins.append(leaf.pattern_idx)
             raise InventoryDependent()
         if isinstance(lv, SConst) and isinstance(rv, SConst):
             return self._const_binop(op, lv, rv, st)
@@ -1572,8 +1769,27 @@ class Compiler:
             )
         return None
 
+    def _materialize_msg(self, v: SVal) -> SVal:
+        """SMsg with a transform recipe -> derived SScalar (comparison
+        position forces the lazy sprintf into an id-transform table)."""
+        if isinstance(v, SMsg) and v.recipe is not None:
+            fv, arg = v.recipe
+            forks = self._str_transform(
+                arg,
+                State(env={}),
+                f"sprintf:{fv}",
+                lambda s, _f=fv: _f.replace("%v", s, 1),
+            )
+            if forks:
+                part = forks[0][0]
+                if isinstance(part, SScalar):
+                    return replace(part, msg_sig=v.sig)
+                return part
+        return v
+
     def _sym_eq(self, lv: SVal, rv: SVal) -> Tuple[Expr, bool]:
         lv, rv = self._leafify(lv), self._leafify(rv)
+        lv, rv = self._materialize_msg(lv), self._materialize_msg(rv)
         if isinstance(lv, SConst) and not isinstance(rv, SConst):
             lv, rv = rv, lv
         if isinstance(rv, SConst):
@@ -2071,18 +2287,35 @@ class Compiler:
                 else None
             )
             if items is not None:
-                return [
-                    (
-                        SMsg(
-                            sig=(
-                                "sprintf",
-                                fmt.value,
-                                tuple(_val_sig(v) for v in items),
-                            )
-                        ),
-                        st,
+                sig = (
+                    "sprintf",
+                    fmt.value,
+                    tuple(_val_sig(v) for v in items),
+                )
+                # value-position form: a single symbolic string argument
+                # with one %v verb compiles to an id transform so the
+                # result can join/compare (apparmor's annotation-key
+                # construction); the msg_sig keeps head-dedup semantics
+                arg0 = None
+                if len(items) == 1:
+                    try:
+                        arg0 = self._leafify(items[0])
+                    except CompileUnsupported:
+                        arg0 = None
+                if (
+                    arg0 is not None
+                    and isinstance(fmt.value, str)
+                    and fmt.value.count("%") == 1
+                    and "%v" in fmt.value
+                    and isinstance(arg0, (SScalar, SKey))
+                    and not (
+                        isinstance(arg0, SScalar)
+                        and arg0.num_override is not None
                     )
-                ]
+                ):
+                    # lazily materializable (see SMsg.recipe)
+                    return [(SMsg(sig=sig, recipe=(fmt.value, arg0)), st)]
+                return [(SMsg(sig=sig), st)]
         return [(SMsg(), st)]
 
     def _builtin_concat(self, args, st):
@@ -2242,6 +2475,8 @@ def _val_sig(v):
     if isinstance(v, SMsg):
         return v.signature()
     if isinstance(v, SScalar):
+        if v.msg_sig is not None:
+            return v.msg_sig
         if v.pattern_idx >= 0 and v.num_override is None:
             return ("p", v.pattern_idx, v.tok_space)
         return ("deriv", id(v))

@@ -34,6 +34,10 @@ class _Table:
     persist_key: Optional[str] = None
     persist_store: Optional[Dict[str, Tuple[Any, bool]]] = None
     persist_new: int = 0
+    # transform tables (str_transform): True enables the composition-
+    # depth cutoff in _fill — without it, mutually prefixing transforms
+    # grow the vocab exponentially under sync()'s fixed point
+    is_transform: bool = False
 
 
 class StrTables:
@@ -41,6 +45,16 @@ class StrTables:
         self.vocab = vocab
         self._tables: Dict[str, _Table] = {}
         self.generation = 0
+        # transform-composition depth per vocab id: organically interned
+        # entries (corpus values, captures, params) have depth 0; a
+        # transform output's depth is input+1. Transforms skip inputs at
+        # depth >= XF_MAX_DEPTH, bounding the sync() fixed point while
+        # still transforming every organic string AND one level of
+        # cross-table composition (tabA[tabB[vid]] chains). Only strings
+        # whose content coincides with a depth>=2 composed product can
+        # see an undefined transform — documented corner.
+        self._xf_depth: Dict[int, int] = {}
+        self._fill_depth = 0  # depth of the entry currently being filled
 
     def register(
         self,
@@ -48,6 +62,7 @@ class StrTables:
         fn: Callable[[Any], Tuple[Any, bool]],
         dtype=np.float32,
         persist_key: Optional[str] = None,
+        is_transform: bool = False,
     ) -> str:
         """Idempotent by name. fn receives the decoded scalar VALUE of each
         vocab entry — a str for "s:" entries, the parsed JSON scalar
@@ -62,6 +77,7 @@ class StrTables:
                 values=np.zeros((0,), dtype),
                 defined=np.zeros((0,), bool),
                 persist_key=persist_key,
+                is_transform=is_transform,
             )
             if persist_key is not None:
                 t.persist_store = _load_persist(persist_key)
@@ -81,6 +97,11 @@ class StrTables:
         defined[:start] = t.defined
         store = t.persist_store
         for i in range(start, n):
+            if t.is_transform:
+                d = self._xf_depth.get(i, 0)
+                if d >= XF_MAX_DEPTH:
+                    continue  # composition-depth cutoff (see __init__)
+                self._fill_depth = d
             raw = self.vocab.string(i)
             val = _decode_entry(raw)
             if val is _SKIP:
@@ -184,18 +205,34 @@ class StrTables:
 
 
     def str_transform(self, name: str, fn: Callable[[str], str]) -> str:
-        """id -> id table: interned result of a pure string transform."""
+        """id -> id table: interned result of a pure string transform.
+        Outputs carry a composition depth (input+1); _fill skips inputs
+        past XF_MAX_DEPTH so the sync() fixed point converges even for
+        mutually prefixing transforms."""
         vocab = self.vocab
 
         def table_fn(s):
             if not isinstance(s, str):
                 return -1, False
-            return vocab.str_id(fn(s)), True
+            try:
+                out = fn(s)
+            except Exception:
+                return -1, False
+            oid = vocab.str_id(out)
+            d = self._fill_depth + 1
+            if d < self._xf_depth.get(oid, 99):
+                self._xf_depth[oid] = d
+            return oid, True
 
-        return self.register(f"xf:{name}", table_fn, dtype=np.int32)
+        return self.register(
+            f"xf:{name}", table_fn, dtype=np.int32, is_transform=True
+        )
 
 
 _SKIP = object()
+
+# transform-composition depth cutoff (see StrTables.__init__)
+XF_MAX_DEPTH = 2
 
 
 def _persist_dir() -> Optional[str]:

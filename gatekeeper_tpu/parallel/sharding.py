@@ -124,6 +124,9 @@ class StackedCorpus:
     k: int
     chunk: int
     key: Tuple
+    # per-row feature planes ([K, chunk] bool device), e.g. the
+    # inventory join-key duplication bits (stage_row_feats)
+    row_dev: Dict[str, Any] = None
 
 
 class FusedAuditKernel:
@@ -362,7 +365,25 @@ class FusedAuditKernel:
                 tok_dev["spath"].shape,
                 fb_dev["group_id"].shape,
             ),
+            row_dev={},
         )
+
+    def stage_row_feats(
+        self, corpus: StackedCorpus, feats: Dict[str, np.ndarray]
+    ) -> None:
+        """Ship per-row feature bits ([N] bool each) to device as
+        [K, chunk] planes alongside the stacked corpus."""
+        for name, arr in feats.items():
+            if name in corpus.row_dev:
+                continue
+            plane = np.zeros((corpus.k, corpus.chunk), bool)
+            flat = np.asarray(arr, bool)
+            for ci in range(corpus.k):
+                start = ci * corpus.chunk
+                end = min(start + corpus.chunk, flat.shape[0])
+                if end > start:
+                    plane[ci, : end - start] = flat[start:end]
+            corpus.row_dev[name] = self._put(plane, None, "n")
 
     def dispatch_need_all(
         self,
@@ -381,22 +402,27 @@ class FusedAuditKernel:
         by the caller (rare: violating rows are sparse in steady state).
         """
         r_cap = min(r_cap, corpus.chunk)
-        key = ("need_all", policy.key, corpus.key, g, r_cap)
+        row_dev = corpus.row_dev or {}
+        key = (
+            "need_all", policy.key, corpus.key, g, r_cap,
+            tuple(sorted(row_dev)),
+        )
         entry = self._jit_cache.get(key)
         if entry is None:
             need_chunk = self._need_chunk_fn(policy, g, r_cap)
 
             def run_all(ms_in, spec_map, fb_in, tok_in, tabs_in,
-                        consts_in, compiled_mask, row_fb, n_valid):
+                        consts_in, compiled_mask, row_fb, n_valid,
+                        row_in):
                 def body(xs):
-                    fb_c, tok_c, rf_c, nv_c = xs
+                    fb_c, tok_c, rf_c, nv_c, row_c = xs
                     return need_chunk(
                         ms_in, spec_map, fb_c, tok_c, tabs_in,
-                        consts_in, compiled_mask, rf_c, nv_c,
+                        consts_in, compiled_mask, rf_c, nv_c, row_c,
                     )
 
                 return jax.lax.map(
-                    body, (fb_in, tok_in, row_fb, n_valid)
+                    body, (fb_in, tok_in, row_fb, n_valid, row_in)
                 )
 
             entry = [run_all, jax.jit(run_all)]
@@ -412,6 +438,7 @@ class FusedAuditKernel:
             policy.compiled_mask,
             corpus.row_fb,
             corpus.n_valid,
+            row_dev,
         )
         return jax.device_get(out)  # one transfer for the whole sweep
 
@@ -423,7 +450,8 @@ class FusedAuditKernel:
         group_cmaps = policy.group_cmaps
 
         def need_chunk(ms_in, spec_map, fb_in, tok_in, tabs_in,
-                       consts_in, compiled_mask, row_fb, n_valid):
+                       consts_in, compiled_mask, row_fb, n_valid,
+                       row_in=None):
             from ..engine.exprs import EvalCtx
 
             # [U+1, N] over distinct specs, gathered back to [C_pad, N]
@@ -465,6 +493,7 @@ class FusedAuditKernel:
                         g1=g,
                         slabs=slabs,
                         slab_cols=slab_cols,
+                        row=row_in,
                     )
                     return expr.emit(ctx).astype(jnp.int32)
 

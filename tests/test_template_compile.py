@@ -408,6 +408,18 @@ TEMPLATE_PARAMS = {
         {"probes": ["livenessProbe"], "probeTypes": ["httpGet"]},
     ],
     "pod-security-policy/allow-privilege-escalation": [{}],
+    # annotation x container joins: compiled via token-space key
+    # iteration under the container axis (rank-3 join) with split/
+    # sprintf id-transforms; row-level safety flags cover the
+    # annotations-is-actually-an-array corner
+    "pod-security-policy/apparmor": [
+        {"allowedProfiles": ["runtime/default"]},
+        {"allowedProfiles": ["runtime/default", "localhost/x"]},
+    ],
+    "pod-security-policy/seccomp": [
+        {"allowedProfiles": ["runtime/default"]},
+        {"allowedProfiles": ["*"]},
+    ],
     "pod-security-policy/capabilities": [
         {"allowedCapabilities": ["CHOWN"], "requiredDropCapabilities": ["ALL"]},
     ],
@@ -460,10 +472,6 @@ TEMPLATE_PARAMS = {
 SCREEN_TEMPLATES = {
     "general/uniqueingresshost": {},        # data.inventory join
     "general/uniqueserviceselector": {},    # data.inventory join
-    "pod-security-policy/apparmor":         # annotations x containers join
-        {"allowedProfiles": ["runtime/default"]},
-    "pod-security-policy/seccomp":
-        {"allowedProfiles": ["runtime/default"]},
     "pod-security-policy/host-filesystem":  # volumes x volumeMounts join
         {"allowedHostPaths": [{"pathPrefix": "/tmp", "readOnly": True}]},
 }

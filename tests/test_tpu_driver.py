@@ -412,3 +412,94 @@ def test_inventory_join_screens_exact_parity():
     # both templates compiled (as screens), none fell back wholesale
     cs = tpu_drv._cset[TARGET]
     assert all(p is not None and p.screen for p in cs.programs)
+
+
+def test_inventory_join_screen_is_sharp():
+    """The invdup row-feature keeps uniqueness-join screens sparse:
+    only rows whose join key is actually duplicated route to the
+    interpreter — unique-keyed rows stay on the device path entirely."""
+    drv = TpuDriver()
+    client = Backend(drv).new_client(K8sValidationTarget())
+    client.add_template(load_template(f"{LIB}/general/uniqueingresshost"))
+    client.add_constraint(make_constraint("K8sUniqueIngressHost", "u"))
+
+    def ing(name, ns, host):
+        return {
+            "apiVersion": "networking.k8s.io/v1beta1",
+            "kind": "Ingress",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"rules": [{"host": host}]},
+        }
+
+    client.add_data(ing("a", "n1", "dup.com"))
+    client.add_data(ing("b", "n2", "dup.com"))
+    for i in range(40):
+        client.add_data(ing(f"u{i}", "n1", f"unique{i}.com"))
+    results = client.audit().by_target[TARGET].results
+    assert len(results) == 2  # only the dup pair violates
+    corpus = drv._corpus[TARGET]
+    feats = corpus.row_feats or {}
+    assert feats, "join refinement feature was not computed"
+    (bits,) = feats.values()
+    assert int(bits.sum()) == 2  # only the 2 dup carriers flagged
+
+
+def test_join_refine_not_applied_across_helper_definitions():
+    """An inventory equality inside ONE definition of a multi-definition
+    helper must NOT screen out forks satisfiable via the other
+    definition (the _no_inv_catch guard on join recording)."""
+    rego = """package multidef
+
+violation[{"msg": "v"}] {
+    check(input.review.object)
+}
+
+check(o) {
+    o.spec.host == data.inventory.cluster[_][_][_].spec.host
+}
+
+check(o) {
+    o.spec.big == "yes"
+}
+"""
+    tmpl = {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "multidef"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "MultiDef"}}},
+            "targets": [
+                {"target": TARGET, "rego": rego}
+            ],
+        },
+    }
+
+    def build(driver):
+        client = Backend(driver).new_client(K8sValidationTarget())
+        client.add_template(tmpl)
+        client.add_constraint(make_constraint("MultiDef", "m"))
+        # a widget violating via the SECOND definition only: its host is
+        # cluster-unique, so a wrongly-ANDed join refinement would
+        # screen it out
+        client.add_data(
+            {
+                "apiVersion": "v1",
+                "kind": "Widget",
+                "metadata": {"name": "w1", "namespace": "d"},
+                "spec": {"host": "unique.example", "big": "yes"},
+            }
+        )
+        client.add_data(
+            {
+                "apiVersion": "v1",
+                "kind": "Widget",
+                "metadata": {"name": "w2", "namespace": "d"},
+                "spec": {"host": "other.example", "big": "no"},
+            }
+        )
+        return client
+
+    want = canon(build(RegoDriver()).audit().by_target[TARGET].results)
+    got = canon(build(TpuDriver()).audit().by_target[TARGET].results)
+    assert got == want
+    assert len(want) == 1  # w1 violates via big == "yes"
