@@ -1,0 +1,86 @@
+"""Native host-side components.
+
+`flatten.c` is the C token-flattener (the "host-side JSON->tensor
+flattening" native component SURVEY §2 reserves): ~10-20x the pure-
+Python encode on big corpora. It is compiled lazily on first use into a
+cached shared object (the repo ships source, not binaries); if the
+toolchain or compile is unavailable the Python encoder is used —
+`encoder.encode_token_table` treats the native path as a strict
+drop-in whose outputs are differentially pinned by
+tests/test_native_flatten.py.
+
+Set GATEKEEPER_TPU_NO_NATIVE=1 to force the Python path.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+
+def _build_dir() -> str:
+    d = os.environ.get(
+        "GATEKEEPER_TPU_NATIVE_DIR",
+        os.path.expanduser("~/.cache/gatekeeper_tpu/native"),
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_flatten_native():
+    """-> the _flatten_native module, building it if needed; None when
+    disabled or the build fails."""
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    with _lock:
+        if _mod is not None or _tried:
+            return _mod
+        _tried = True
+        if os.environ.get("GATEKEEPER_TPU_NO_NATIVE") == "1":
+            return None
+        try:
+            _mod = _load_or_build()
+        except Exception:
+            _mod = None
+        return _mod
+
+
+def _load_or_build():
+    import hashlib
+
+    src = os.path.join(os.path.dirname(__file__), "flatten.c")
+    out_dir = _build_dir()
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    # content-addressed artifact: any source edit rebuilds
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(out_dir, f"_flatten_native_{tag}{suffix}")
+    if not os.path.exists(so):
+        cc = os.environ.get("CC", "gcc")
+        include = sysconfig.get_paths()["include"]
+        # unique temp name: concurrent builders must not clobber each
+        # other mid-write (os.replace makes the install atomic)
+        tmp = f"{so}.build.{os.getpid()}"
+        subprocess.run(
+            [
+                cc, "-O2", "-shared", "-fPIC",
+                f"-I{include}", src, "-o", tmp,
+            ],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, so)
+    spec = importlib.util.spec_from_file_location("_flatten_native", so)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -1,0 +1,129 @@
+"""Differential tests: the C token-flattener must be bit-identical to
+the Python encoder — same arrays AND the same vocab contents/order
+(vocab ids are load-bearing everywhere downstream)."""
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.flatten.encoder import (
+    _encode_token_table_native,
+    encode_token_table,
+)
+from gatekeeper_tpu.flatten.vocab import Vocab
+from gatekeeper_tpu.native import load_flatten_native
+
+native = load_flatten_native()
+
+pytestmark = pytest.mark.skipif(
+    native is None, reason="native flattener unavailable (no toolchain)"
+)
+
+WEIRD_OBJS = [
+    {},
+    [],
+    {"a": {}},
+    {"a": []},
+    {"a": None, "b": True, "c": False},
+    {"n": 0, "m": -7, "f": 1.5, "g": 2.0, "big": 10**12, "neg": -1.25e-9},
+    {"s": "", "t": "hello", "q": "100m", "mem": "2Gi", "e": "1e3",
+     "notq": "12abc", "spaced": "  50Mi  "},
+    {"dotted.key": 1, "has%pct": 2, "#": 3, "a#b": 4,
+     "kubernetes.io/ingress.class": "nginx"},
+    {"arr": [1, [2, [3, [4]]], {"x": "y"}]},
+    {"containers": [
+        {"name": f"c{i}", "ports": [{"p": j} for j in range(3)]}
+        for i in range(5)
+    ]},
+    {"mixed": [{"a": 1}, [], {}, None, "s", 2.5, True]},
+    {"unicode": "héllo wörld", "emoji": "🚀", "cjk": "策略"},
+    {"deep": {"a": {"b": {"c": {"d": {"e": [{"f": [1, 2]}]}}}}}},
+]
+
+
+def _clone_vocab_state(v):
+    return list(v._strs), list(v._quantity)
+
+
+@pytest.mark.parametrize("max_len", [None, 8])
+def test_native_matches_python(max_len):
+    objs = WEIRD_OBJS * 3
+    v_py, v_c = Vocab(), Vocab()
+    # seed both vocabs identically so pre-existing ids exercise lookups
+    for v in (v_py, v_c):
+        v.str_id("hello")
+        v.intern("p:containers.#.name")
+
+    import gatekeeper_tpu.flatten.encoder as E
+
+    # force the Python path for the reference result
+    orig = E._flatten_native
+    E._flatten_native = lambda: None
+    try:
+        want = encode_token_table(objs, v_py, max_len=max_len)
+    finally:
+        E._flatten_native = orig
+    got = _encode_token_table_native(native, objs, v_c, max_len)
+
+    for f in ("spath", "idx0", "idx1", "kind", "vid", "vnum",
+              "n_tokens", "overflow"):
+        a, b = getattr(got, f), getattr(want, f)
+        assert np.array_equal(a, b), f"{f} mismatch"
+    s_py, q_py = _clone_vocab_state(v_py)
+    s_c, q_c = _clone_vocab_state(v_c)
+    assert s_py == s_c, "vocab strings/order diverge"
+    assert q_py == q_c, "vocab quantity memo diverges"
+
+
+def test_native_used_by_default_and_fast():
+    objs = [
+        {"metadata": {"name": f"p{i}", "labels": {"app": f"a{i % 7}"}},
+         "spec": {"containers": [{"name": "c", "image": "nginx",
+                                  "resources": {"limits": {"cpu": "1"}}}]}}
+        for i in range(2000)
+    ]
+    import time
+
+    v1, v2 = Vocab(), Vocab()
+    t0 = time.perf_counter()
+    got = encode_token_table(objs, v1)  # native path
+    t_native = time.perf_counter() - t0
+
+    import gatekeeper_tpu.flatten.encoder as E
+
+    orig = E._flatten_native
+    E._flatten_native = lambda: None
+    try:
+        t0 = time.perf_counter()
+        want = encode_token_table(objs, v2)
+        t_py = time.perf_counter() - t0
+    finally:
+        E._flatten_native = orig
+    assert np.array_equal(got.spath, want.spath)
+    assert np.array_equal(got.vid, want.vid)
+    assert list(v1._strs) == list(v2._strs)
+    # the point of the native encoder; generous margin for CI noise
+    assert t_native < t_py, (t_native, t_py)
+
+
+def test_native_quantity_fallback_parity():
+    """Inputs the C parser delegates to Python (unicode whitespace, long
+    mantissas) and non-finite floats must still match bit-exactly."""
+    objs = [
+        {"nbsp": " 100m", "long": "0" * 70 + "1" + "Gi",
+         "inf": float("inf"), "ninf": float("-inf"),
+         "uspace": "  2Gi  ", "plain": "250m"},
+    ]
+    import gatekeeper_tpu.flatten.encoder as E
+
+    v_py, v_c = Vocab(), Vocab()
+    orig = E._flatten_native
+    E._flatten_native = lambda: None
+    try:
+        want = encode_token_table(objs, v_py)
+    finally:
+        E._flatten_native = orig
+    got = _encode_token_table_native(native, objs, v_c, None)
+    for f in ("spath", "kind", "vid", "vnum"):
+        assert np.array_equal(getattr(got, f), getattr(want, f)), f
+    assert list(v_py._strs) == list(v_c._strs)
+    assert list(v_py._quantity) == list(v_c._quantity)
