@@ -31,6 +31,7 @@ from .controllers import (  # noqa: F401
     constraint_gvk,
 )
 from .status import StatusAggregator, StatusWriter  # noqa: F401
+from .upgrade import UpgradeManager  # noqa: F401
 from .runner import (  # noqa: F401
     ALL_OPERATIONS,
     OPERATION_AUDIT,

@@ -208,6 +208,25 @@ class Runner:
         self.tracker.config.expectations_done()
 
     def start(self) -> None:
+        # stored-version migration first (pkg/upgrade runs before the
+        # controllers see state; deprecated-version objects must be
+        # visible at the preferred version the watches use)
+        from .upgrade import UpgradeManager
+
+        self.upgrade_mgr = UpgradeManager(self.cluster)
+        try:
+            self.upgrade_mgr.upgrade()
+        except Exception:
+            # upgrade failures must not block serving (the reference
+            # logs and continues, upgrade/manager.go) — but they must
+            # not be invisible either
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "stored-version upgrade failed; deprecated-version "
+                "objects may not be ingested"
+            )
+
         self._populate_expectations()
 
         # watch registration order mirrors setupControllers: templates

@@ -71,11 +71,13 @@ def _load_or_build():
         # unique temp name: concurrent builders must not clobber each
         # other mid-write (os.replace makes the install atomic)
         tmp = f"{so}.build.{os.getpid()}"
+        cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{include}", src,
+               "-o", tmp]
+        if sys.platform == "darwin":
+            # clang needs the Python symbols left undefined at link time
+            cmd[2:2] = ["-undefined", "dynamic_lookup"]
         subprocess.run(
-            [
-                cc, "-O2", "-shared", "-fPIC",
-                f"-I{include}", src, "-o", tmp,
-            ],
+            cmd,
             check=True,
             capture_output=True,
         )

@@ -45,6 +45,8 @@ typedef struct {
     Py_ssize_t len, cap;
     int32_t *row_off; /* [n_rows+1] offsets into the flat arrays */
     Py_ssize_t rows_len, rows_cap;
+    int depth;        /* recursion guard (C stack overflow would
+                         segfault where Python raises RecursionError) */
     char *path;       /* growing "a.b.#.c" buffer */
     Py_ssize_t path_len, path_cap;
     PyObject *ids;    /* vocab._ids dict (borrowed) */
@@ -86,10 +88,13 @@ static int path_reserve(Enc *e, Py_ssize_t extra) {
 static int parse_quantity(const char *s, Py_ssize_t n, double *out) {
     for (Py_ssize_t j = 0; j < n; j++)
         if ((unsigned char)s[j] >= 0x80) return -1;
-    while (n && (s[0] == ' ' || s[0] == '\t' || s[0] == '\n' || s[0] == '\r'
-                 || s[0] == '\f' || s[0] == '\v')) { s++; n--; }
-    while (n && (s[n-1] == ' ' || s[n-1] == '\t' || s[n-1] == '\n'
-                 || s[n-1] == '\r' || s[n-1] == '\f' || s[n-1] == '\v')) n--;
+    /* python str.strip() whitespace (ASCII subset; >=0x80 fell back
+     * above): space, \t-\r, and \x1c-\x1f */
+#define IS_WS(c) ((c) == ' ' || ((c) >= '\t' && (c) <= '\r') \
+                  || ((c) >= 0x1c && (c) <= 0x1f))
+    while (n && IS_WS((unsigned char)s[0])) { s++; n--; }
+    while (n && IS_WS((unsigned char)s[n-1])) n--;
+#undef IS_WS
     if (!n) return 0;
     Py_ssize_t i = 0;
     if (s[i] == '+' || s[i] == '-') i++;
@@ -309,9 +314,21 @@ static int rec_list(Enc *e, PyObject *v, int32_t i0, int32_t i1) {
     return 0;
 }
 
+#define MAX_DEPTH 512
+
 static int rec(Enc *e, PyObject *v, int32_t i0, int32_t i1) {
-    if (PyDict_Check(v)) return rec_dict(e, v, i0, i1);
-    if (PyList_Check(v)) return rec_list(e, v, i0, i1);
+    if (PyDict_Check(v) || PyList_Check(v)) {
+        if (++e->depth > MAX_DEPTH) {
+            e->depth--;
+            PyErr_SetString(PyExc_RecursionError,
+                            "object nesting too deep for native flatten");
+            return -1;
+        }
+        int rc = PyDict_Check(v) ? rec_dict(e, v, i0, i1)
+                                 : rec_list(e, v, i0, i1);
+        e->depth--;
+        return rc;
+    }
     if (PyBool_Check(v)) {
         int truth = (v == Py_True);
         return emit(e, i0, i1, K_BOOL, "j:", truth ? "true" : "false",

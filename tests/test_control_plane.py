@@ -541,3 +541,55 @@ def test_excluded_data_does_not_wedge_readiness():
         assert audit_results(runner).total_violations == 1  # only "normal"
     finally:
         runner.stop()
+
+
+def test_upgrade_manager_migrates_stored_versions():
+    """pkg/upgrade parity: gatekeeper objects stored at v1alpha1 are
+    migrated to v1beta1 before the controllers watch, so they ingest."""
+    cluster = FakeCluster()
+    old_tmpl = template("K8sRequiredLabels", REQ_LABELS)
+    old_tmpl["apiVersion"] = "templates.gatekeeper.sh/v1alpha1"
+    cluster.apply(old_tmpl)
+    old_c = constraint(
+        "K8sRequiredLabels", "need-owner", params={"labels": ["owner"]}
+    )
+    old_c["apiVersion"] = "constraints.gatekeeper.sh/v1alpha1"
+    cluster.apply(old_c)
+    cluster.apply(config())
+    cluster.apply(pod("bad"))
+
+    runner = make_runner(cluster)
+    runner.start()
+    try:
+        assert runner.wait_ready(30), runner.tracker.stats()
+        assert len(runner.upgrade_mgr.upgraded) == 2
+        # migrated objects live at v1beta1 now...
+        assert cluster.list(TEMPLATE_GVK)
+        assert not cluster.list(
+            GVK("templates.gatekeeper.sh", "v1alpha1", "ConstraintTemplate")
+        )
+        # ...and were ingested: the policy enforces
+        assert audit_results(runner).total_violations == 1
+    finally:
+        runner.stop()
+
+
+def test_upgrade_never_clobbers_preferred_version():
+    """A stale v1alpha1 copy must not overwrite the live v1beta1 object
+    of the same name during migration."""
+    from gatekeeper_tpu.control import UpgradeManager
+
+    cluster = FakeCluster()
+    new_tmpl = template("K8sRequiredLabels", REQ_LABELS)
+    cluster.apply(new_tmpl)
+    stale = template("K8sRequiredLabels", DENY_ALL)
+    stale["apiVersion"] = "templates.gatekeeper.sh/v1alpha1"
+    cluster.apply(stale)
+
+    UpgradeManager(cluster).upgrade()
+    (kept,) = cluster.list(TEMPLATE_GVK)
+    rego = kept["spec"]["targets"][0]["rego"]
+    assert "required" in rego  # the v1beta1 content survived
+    assert not cluster.list(
+        GVK("templates.gatekeeper.sh", "v1alpha1", "ConstraintTemplate")
+    )

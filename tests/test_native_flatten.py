@@ -127,3 +127,36 @@ def test_native_quantity_fallback_parity():
         assert np.array_equal(getattr(got, f), getattr(want, f)), f
     assert list(v_py._strs) == list(v_c._strs)
     assert list(v_py._quantity) == list(v_c._quantity)
+
+
+def test_native_deep_nesting_degrades_not_crashes():
+    """Pathologically deep objects must raise a catchable error (and the
+    public encoder falls back to the Python path's RecursionError), not
+    segfault via C stack overflow."""
+    deep = {}
+    cur = deep
+    for _ in range(5000):
+        cur["a"] = {}
+        cur = cur["a"]
+    cur["leaf"] = 1
+    v = Vocab()
+    with pytest.raises(RecursionError):
+        _encode_token_table_native(native, [deep], v, None)
+
+
+def test_native_control_whitespace_quantity_parity():
+    r"""\x1c-\x1f are str.strip() whitespace in Python; the C parser must
+    agree on quantities wrapped in them."""
+    objs = [{"q": "\x1c100m\x1f", "r": "\x1d2Gi"}]
+    import gatekeeper_tpu.flatten.encoder as E
+
+    v_py, v_c = Vocab(), Vocab()
+    orig = E._flatten_native
+    E._flatten_native = lambda: None
+    try:
+        want = encode_token_table(objs, v_py)
+    finally:
+        E._flatten_native = orig
+    got = _encode_token_table_native(native, objs, v_c, None)
+    assert np.array_equal(got.vnum, want.vnum)
+    assert list(v_py._quantity) == list(v_c._quantity)
