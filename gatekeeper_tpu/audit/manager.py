@@ -113,9 +113,23 @@ class AuditManager:
         metrics=None,
         event_sink: Optional[Callable[[Dict[str, Any]], None]] = None,
         emit_audit_events: bool = False,
+        # --audit-from-cache (manager.go:194-206): True sweeps the
+        # synced OPA cache in one fused call; False mirrors the
+        # reference DEFAULT — list every cluster GVK directly
+        # (auditResources, manager.go:232-342) in --audit-chunk-size
+        # batches through the batched review path, covering GVKs the
+        # Config never syncs
+        audit_from_cache: bool = True,
+        cluster=None,
+        audit_chunk_size: int = 512,
+        excluder=None,
     ):
         self.client = client
         self.target = target
+        self.audit_from_cache = audit_from_cache
+        self.cluster = cluster
+        self.audit_chunk_size = audit_chunk_size
+        self.excluder = excluder
         self.sink = sink if sink is not None else InMemorySink()
         self.audit_interval = audit_interval
         self.violations_limit = constraint_violations_limit
@@ -136,14 +150,19 @@ class AuditManager:
     # -- one sweep -----------------------------------------------------------
 
     def audit(self) -> AuditReport:
-        """One full sweep: Client.audit over the cached state, then the
-        reference's aggregation contract (cap, truncate, publish)."""
+        """One full sweep, then the reference's aggregation contract
+        (cap, truncate, publish). From-cache mode sweeps the synced
+        state in one fused Client.audit; direct mode lists the cluster
+        GVK-by-GVK in chunks through the batched review path."""
         t0 = self._now()
         timestamp = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime(int(t0))
         )
-        resp = self.client.audit().by_target.get(self.target)
-        results = resp.results if resp is not None else []
+        if self.audit_from_cache or self.cluster is None:
+            resp = self.client.audit().by_target.get(self.target)
+            results = resp.results if resp is not None else []
+        else:
+            results = self._audit_resources()
 
         statuses: Dict[str, ConstraintStatus] = {}
         totals_by_ea: Dict[str, int] = {}
@@ -225,6 +244,56 @@ class AuditManager:
                 )
             self._reported_eas |= set(totals_by_ea)
         return report
+
+    def _audit_resources(self) -> List[Any]:
+        """The reference's default path (auditResources,
+        manager.go:232-342): list EVERY listable cluster GVK — synced
+        or not — skipping gatekeeper's own kinds, and review objects in
+        audit-chunk-size batches (each batch is one fused device
+        dispatch via review_many; the reference issues one interpreted
+        query per object here)."""
+        from ..constraint import AugmentedUnstructured
+
+        skip_groups = {
+            "constraints.gatekeeper.sh",
+            "templates.gatekeeper.sh",
+            "config.gatekeeper.sh",
+            "status.gatekeeper.sh",
+        }
+        from ..control.events import GVK
+
+        ns_gvk = GVK("", "v1", "Namespace")
+        results: List[Any] = []
+        for gvk in sorted(self.cluster.known_gvks()):
+            if gvk.group in skip_groups:
+                continue
+            objs = self.cluster.list(gvk)
+            for start in range(0, len(objs), self.audit_chunk_size):
+                chunk = objs[start : start + self.audit_chunk_size]
+                reviews = []
+                for obj in chunk:
+                    ns = (obj.get("metadata") or {}).get("namespace") or ""
+                    if (
+                        ns
+                        and self.excluder is not None
+                        and self.excluder.is_namespace_excluded("audit", ns)
+                    ):
+                        continue
+                    # attach the Namespace object (the reference's
+                    # nsCache.Get, manager.go:299-317) — without it the
+                    # review carries no namespace and every constraint-
+                    # level namespace match degrades to cluster-scoped
+                    ns_obj = (
+                        self.cluster.get(ns_gvk, "", ns) if ns else None
+                    )
+                    reviews.append(AugmentedUnstructured(obj, ns_obj))
+                if not reviews:
+                    continue
+                for responses in self.client.review_many(reviews):
+                    resp = responses.by_target.get(self.target)
+                    if resp is not None:
+                        results.extend(resp.results)
+        return results
 
     # -- sweep loop (auditManagerLoop, manager.go:344-358) -------------------
 

@@ -68,6 +68,8 @@ class Runner:
         webhook_tls: bool = False,
         emit_admission_events: bool = False,
         emit_audit_events: bool = False,
+        audit_from_cache: bool = True,
+        enable_profiler: bool = False,
     ):
         self.cluster = cluster
         self.client = client
@@ -89,6 +91,13 @@ class Runner:
         )
         self.status_agg = StatusAggregator()
         self.audit_interval = audit_interval
+        self.audit_from_cache = audit_from_cache
+        # --enable-pprof equivalent (main.go:89-90,111-117): when on,
+        # the readyz server also exposes /debug/profile?seconds=N which
+        # captures a JAX profiler trace (XPlane) — the device-side
+        # analog of the reference's net/http/pprof endpoint
+        self.enable_profiler = enable_profiler
+        self._profile_lock = threading.Lock()
         self.webhook_port = webhook_port
         self.readyz_port = readyz_port
         self.exempt_namespaces = list(exempt_namespaces)
@@ -266,6 +275,9 @@ class Runner:
                 metrics=self.metrics,
                 event_sink=self.events.append,
                 emit_audit_events=self.emit_audit_events,
+                audit_from_cache=self.audit_from_cache,
+                cluster=self.cluster,
+                excluder=self.excluder,
             )
             self.audit.start()
 
@@ -308,6 +320,38 @@ class Runner:
     def _get_namespace(self, name: str) -> Optional[dict]:
         return self.cluster.get(NAMESPACE_GVK, "", name)
 
+    def _capture_profile(self, path: str) -> bytes:
+        """Capture a JAX profiler trace for ?seconds=N (default 2,
+        clamped to [0, 60]); returns JSON naming the XPlane trace
+        directory (open with TensorBoard / xprof) or an error. One
+        capture at a time (the profiler rejects nesting). Concurrent
+        device work — sweeps, webhook dispatches — lands in the trace."""
+        import tempfile
+        import time as _time
+        from urllib.parse import parse_qs, urlparse
+
+        import jax
+
+        try:
+            q = parse_qs(urlparse(path).query)
+            seconds = float(q.get("seconds", ["2"])[0])
+        except (ValueError, TypeError):
+            return json.dumps({"error": "bad seconds parameter"}).encode()
+        seconds = max(0.0, min(seconds, 60.0))
+        if not self._profile_lock.acquire(blocking=False):
+            return json.dumps(
+                {"error": "a profile capture is already running"}
+            ).encode()
+        try:
+            out_dir = tempfile.mkdtemp(prefix="gk-jaxprof-")
+            with jax.profiler.trace(out_dir):
+                _time.sleep(seconds)
+            return json.dumps({"trace_dir": out_dir}).encode()
+        except Exception as e:
+            return json.dumps({"error": str(e)}).encode()
+        finally:
+            self._profile_lock.release()
+
     def _serve_readyz(self) -> None:
         runner = self
 
@@ -321,6 +365,12 @@ class Runner:
                     self.send_response(200 if ok else 503)
                 elif self.path == "/healthz":
                     payload = b'{"ok": true}'
+                    self.send_response(200)
+                elif (
+                    runner.enable_profiler
+                    and self.path.startswith("/debug/profile")
+                ):
+                    payload = runner._capture_profile(self.path)
                     self.send_response(200)
                 else:
                     payload = b"not found"

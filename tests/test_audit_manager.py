@@ -141,3 +141,153 @@ def test_second_sweep_reuses_encoded_corpus(manager):
     c1 = drv._corpus[TARGET]
     mgr.audit()
     assert drv._corpus[TARGET] is c1
+
+
+def test_audit_resources_covers_unsynced_gvks():
+    """The direct-list audit mode (the reference DEFAULT, auditResources
+    manager.go:232-342) finds violations in GVKs the Config never
+    synced, skipping gatekeeper's own kinds and excluded namespaces."""
+    from gatekeeper_tpu.constraint import (
+        Backend,
+        K8sValidationTarget,
+        RegoDriver,
+    )
+    from gatekeeper_tpu.control import Excluder, FakeCluster
+
+    cluster = FakeCluster()
+    client = Backend(RegoDriver()).new_client(K8sValidationTarget())
+    client.add_template(
+        {
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "anydeny"},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": "AnyDeny"}}},
+                "targets": [
+                    {
+                        "target": "admission.k8s.gatekeeper.sh",
+                        "rego": 'package anydeny\n\nviolation[{"msg": m}] '
+                        '{ input.review.object.metadata.labels.bad\n'
+                        'm := "bad label" }\n',
+                    }
+                ],
+            },
+        }
+    )
+    client.add_constraint(
+        {
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "AnyDeny",
+            "metadata": {"name": "deny-bad"},
+            "spec": {},
+        }
+    )
+    # NOTHING synced into the client's data cache: the cached-state
+    # audit sees zero objects, the direct mode lists the cluster
+    def widget(name, ns, bad=False):
+        labels = {"bad": "1"} if bad else {}
+        return {
+            "apiVersion": "widgets.example.com/v1",
+            "kind": "Widget",
+            "metadata": {"name": name, "namespace": ns, "labels": labels},
+        }
+
+    cluster.apply(widget("w-bad", "default", bad=True))
+    cluster.apply(widget("w-ok", "default"))
+    cluster.apply(widget("w-excluded", "kube-system", bad=True))
+    cluster.apply(  # gatekeeper's own kinds are skipped
+        {
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "AnyDeny",
+            "metadata": {"name": "deny-bad", "labels": {"bad": "1"}},
+            "spec": {},
+        }
+    )
+    excluder = Excluder()
+    excluder.replace(
+        [{"processes": ["audit"], "excludedNamespaces": ["kube-system"]}]
+    )
+
+    cached = AuditManager(client, TARGET, audit_interval=3600).audit()
+    assert cached.total_violations == 0  # nothing synced
+
+    direct = AuditManager(
+        client,
+        TARGET,
+        audit_interval=3600,
+        audit_from_cache=False,
+        cluster=cluster,
+        excluder=excluder,
+        audit_chunk_size=1,  # exercise chunking
+    ).audit()
+    assert direct.total_violations == 1
+    (st,) = direct.statuses.values()
+    assert st.violations[0].name == "w-bad"
+
+
+def test_audit_resources_attaches_namespaces_for_matching():
+    """Direct-list audit must attach the Namespace object so
+    constraint-level namespace matching works (manager.go:299-317)."""
+    from gatekeeper_tpu.constraint import (
+        Backend,
+        K8sValidationTarget,
+        RegoDriver,
+    )
+    from gatekeeper_tpu.control import FakeCluster
+
+    cluster = FakeCluster()
+    client = Backend(RegoDriver()).new_client(K8sValidationTarget())
+    client.add_template(
+        {
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "alldeny"},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": "AllDeny"}}},
+                "targets": [
+                    {
+                        "target": "admission.k8s.gatekeeper.sh",
+                        "rego": 'package alldeny\n\nviolation[{"msg": "no"}]'
+                        " { true }\n",
+                    }
+                ],
+            },
+        }
+    )
+    client.add_constraint(
+        {
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "AllDeny",
+            "metadata": {"name": "prod-only"},
+            "spec": {
+                "match": {
+                    "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+                    "namespaces": ["prod"],
+                    "namespaceSelector": {
+                        "matchLabels": {"env": "prod"}
+                    },
+                }
+            },
+        }
+    )
+    for ns, labels in (("prod", {"env": "prod"}), ("dev", {"env": "dev"})):
+        cluster.apply(
+            {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": ns, "labels": labels}}
+        )
+        cluster.apply(
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": f"p-{ns}", "namespace": ns},
+             "spec": {"containers": [{"name": "c", "image": "x"}]}}
+        )
+
+    direct = AuditManager(
+        client, TARGET, audit_interval=3600,
+        audit_from_cache=False, cluster=cluster,
+    ).audit()
+    # only the prod pod matches (namespaces + namespaceSelector both
+    # need the namespace attached to resolve)
+    names = [
+        v.name for st in direct.statuses.values() for v in st.violations
+    ]
+    assert names == ["p-prod"], names

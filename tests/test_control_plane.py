@@ -593,3 +593,36 @@ def test_upgrade_never_clobbers_preferred_version():
     assert not cluster.list(
         GVK("templates.gatekeeper.sh", "v1alpha1", "ConstraintTemplate")
     )
+
+
+def test_debug_profiler_endpoint():
+    """--enable-pprof equivalent: /debug/profile captures a JAX profiler
+    trace and names its directory; off by default (404)."""
+    import os as _os
+    import urllib.error
+
+    cluster = FakeCluster()
+    runner = make_runner(cluster, enable_profiler=True, readyz_port=0,
+                         operations=[OPERATION_AUDIT])
+    runner.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{runner.readyz_port}/debug/profile?seconds=0.1",
+            timeout=30,
+        ) as r:
+            out = json.loads(r.read())
+        assert _os.path.isdir(out["trace_dir"])
+    finally:
+        runner.stop()
+
+    off = make_runner(cluster, readyz_port=0, operations=[OPERATION_AUDIT])
+    off.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{off.readyz_port}/debug/profile",
+                timeout=10,
+            )
+        assert exc.value.code == 404
+    finally:
+        off.stop()
