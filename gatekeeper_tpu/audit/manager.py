@@ -261,8 +261,10 @@ class AuditManager:
             "status.gatekeeper.sh",
         }
         from ..control.events import GVK
+        from ..control.process import PROCESS_AUDIT
 
         ns_gvk = GVK("", "v1", "Namespace")
+        ns_cache: Dict[str, Any] = {}  # per-sweep (nsCache, manager.go:299)
         results: List[Any] = []
         for gvk in sorted(self.cluster.known_gvks()):
             if gvk.group in skip_groups:
@@ -276,17 +278,27 @@ class AuditManager:
                     if (
                         ns
                         and self.excluder is not None
-                        and self.excluder.is_namespace_excluded("audit", ns)
+                        and self.excluder.is_namespace_excluded(
+                            PROCESS_AUDIT, ns
+                        )
                     ):
                         continue
                     # attach the Namespace object (the reference's
                     # nsCache.Get, manager.go:299-317) — without it the
                     # review carries no namespace and every constraint-
-                    # level namespace match degrades to cluster-scoped
-                    ns_obj = (
-                        self.cluster.get(ns_gvk, "", ns) if ns else None
-                    )
-                    reviews.append(AugmentedUnstructured(obj, ns_obj))
+                    # level namespace match degrades to cluster-scoped.
+                    # A namespaced object whose Namespace is missing is
+                    # SKIPPED like the reference's lookup-failure path
+                    # (manager.go:307-311 logs and continues).
+                    if ns:
+                        if ns not in ns_cache:
+                            ns_cache[ns] = self.cluster.get(ns_gvk, "", ns)
+                        ns_obj = ns_cache[ns]
+                        if ns_obj is None:
+                            continue
+                        reviews.append(AugmentedUnstructured(obj, ns_obj))
+                    else:
+                        reviews.append(AugmentedUnstructured(obj, None))
                 if not reviews:
                     continue
                 for responses in self.client.review_many(reviews):

@@ -336,19 +336,21 @@ class Runner:
             q = parse_qs(urlparse(path).query)
             seconds = float(q.get("seconds", ["2"])[0])
         except (ValueError, TypeError):
-            return json.dumps({"error": "bad seconds parameter"}).encode()
+            return 400, json.dumps(
+                {"error": "bad seconds parameter"}
+            ).encode()
         seconds = max(0.0, min(seconds, 60.0))
         if not self._profile_lock.acquire(blocking=False):
-            return json.dumps(
+            return 409, json.dumps(
                 {"error": "a profile capture is already running"}
             ).encode()
         try:
             out_dir = tempfile.mkdtemp(prefix="gk-jaxprof-")
             with jax.profiler.trace(out_dir):
                 _time.sleep(seconds)
-            return json.dumps({"trace_dir": out_dir}).encode()
+            return 200, json.dumps({"trace_dir": out_dir}).encode()
         except Exception as e:
-            return json.dumps({"error": str(e)}).encode()
+            return 500, json.dumps({"error": str(e)}).encode()
         finally:
             self._profile_lock.release()
 
@@ -370,8 +372,8 @@ class Runner:
                     runner.enable_profiler
                     and self.path.startswith("/debug/profile")
                 ):
-                    payload = runner._capture_profile(self.path)
-                    self.send_response(200)
+                    code, payload = runner._capture_profile(self.path)
+                    self.send_response(code)
                 else:
                     payload = b"not found"
                     self.send_response(404)

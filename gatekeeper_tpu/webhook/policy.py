@@ -124,13 +124,24 @@ class ValidationHandler:
     # -- entry ---------------------------------------------------------------
 
     def handle(self, request: Dict[str, Any]) -> AdmissionResponse:
+        import time as _time
+
+        t0 = _time.perf_counter()
         resp = self._handle(request)
         if self.metrics is not None:
             status = (
                 "allow" if resp.allowed
                 else ("error" if resp.code >= 500 else "deny")
             )
+            # the webhook stats reporter's surface (request_count +
+            # request_duration_seconds tagged by admission_status,
+            # pkg/webhook/stats_reporter.go:34-79)
             self.metrics.record("request_count", 1, admission_status=status)
+            self.metrics.observe(
+                "request_duration_seconds",
+                _time.perf_counter() - t0,
+                admission_status=status,
+            )
         return resp
 
     def _handle(self, request: Dict[str, Any]) -> AdmissionResponse:

@@ -192,9 +192,17 @@ def test_audit_resources_covers_unsynced_gvks():
             "metadata": {"name": name, "namespace": ns, "labels": labels},
         }
 
+    for ns in ("default", "kube-system"):
+        cluster.apply(
+            {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": ns}}
+        )
     cluster.apply(widget("w-bad", "default", bad=True))
     cluster.apply(widget("w-ok", "default"))
     cluster.apply(widget("w-excluded", "kube-system", bad=True))
+    # a namespaced object whose Namespace is missing is skipped (the
+    # reference's ns-lookup-failure path, manager.go:307-311)
+    cluster.apply(widget("w-orphan", "ghost-ns", bad=True))
     cluster.apply(  # gatekeeper's own kinds are skipped
         {
             "apiVersion": "constraints.gatekeeper.sh/v1beta1",

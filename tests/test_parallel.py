@@ -71,6 +71,9 @@ def test_entry_compiles():
     fn, args = ge.entry()
     out = jax.jit(fn)(*args)
     match, counts, totals = out
-    assert match.shape == (2, 16)
-    assert counts.shape == (2, 16)
-    assert totals.shape == (2,)
+    # 3 constraints (labels, privileged, unique-host screen) over
+    # 16 pods + 6 gateways, padded to the 32-row bucket? no — rows
+    # follow the corpus bucket (22 = 16 pods + 6 gateways)
+    assert match.shape == (3, 22)
+    assert counts.shape == (3, 22)
+    assert totals.shape == (3,)
