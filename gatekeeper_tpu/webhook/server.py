@@ -271,10 +271,16 @@ class WebhookServer:
                 except Exception as e:
                     payload = json.dumps({"error": str(e)}).encode()
                     self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                try:
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except (BrokenPipeError, ConnectionResetError):
+                    # client gave up (its own timeout) mid-response —
+                    # nothing to salvage, and the handler thread must
+                    # not die noisily
+                    pass
 
             def log_message(self, *args):  # silence default stderr spam
                 pass
