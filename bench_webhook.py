@@ -188,8 +188,15 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
                 make_request(i, violating=violating)
                 for i in range(n_requests)
             ]
+            # the violating high-concurrency point saturates on exact
+            # message rendering (~25 rps on one host core); a smaller
+            # sample measures the same saturated p50/throughput without
+            # spending minutes of bench wall-time on it
+            hi_n = max(1500, n_requests // 6) if violating else (
+                max(4000, n_requests // 2)
+            )
             for conc, n_sub in ((8, max(400, n_requests // 25)),
-                                (128, max(4000, n_requests // 2))):
+                                (128, hi_n)):
                 batcher.batches_dispatched = 0
                 batcher.requests_batched = 0
                 r = replay(handler, requests[:n_sub], conc)
