@@ -426,6 +426,17 @@ def matching_constraints(
 # -- autoreject -------------------------------------------------------------
 
 
+def needs_ns_selector(constraint: Dict[str, Any]) -> bool:
+    """The ONLY constraint-dependent clause of autoreject_review: the
+    constraint declares a namespaceSelector. Exported separately so
+    batched callers can factor autoreject as
+    `needs_ns_selector(c) AND review_autorejects(r)` in O(R + C); any
+    future per-constraint condition MUST be added here (and the batched
+    device path in tpudriver._query_many_device revisited), never
+    inlined into autoreject alone."""
+    return _has_field(constraint_match(constraint), "namespaceSelector")
+
+
 def autoreject(
     constraint: Dict[str, Any], review: Any, ns_cache: Dict[str, Any]
 ) -> bool:
@@ -438,10 +449,17 @@ def autoreject(
     cache lookup (`not DataRoot...Namespace[input.review.namespace]`), so an
     absent field fails the whole rule — cluster-scoped reviews never
     autoreject.
+
+    Factored as needs_ns_selector(constraint) AND
+    review_autorejects(review, ns_cache).
     """
-    match = constraint_match(constraint)
-    if not _has_field(match, "namespaceSelector"):
-        return False
+    return needs_ns_selector(constraint) and review_autorejects(
+        review, ns_cache
+    )
+
+
+def review_autorejects(review: Any, ns_cache: Dict[str, Any]) -> bool:
+    """The review-side (constraint-independent) half of autoreject."""
     ns_name = _review_namespace(review)
     if ns_name is _MISSING:
         return False

@@ -503,3 +503,71 @@ check(o) {
     got = canon(build(TpuDriver()).audit().by_target[TARGET].results)
     assert got == want
     assert len(want) == 1  # w1 violates via big == "yes"
+
+
+def test_batched_autoreject_parity_on_device_path():
+    """Large batches (device-routed) must emit the same autoreject
+    results as the serial interpreter: nsSelector constraints with an
+    uncached namespace reject, per constraint, in constraint order."""
+
+    def build(driver):
+        client = Backend(driver).new_client(K8sValidationTarget())
+        client.add_template(load_template(f"{LIB}/general/requiredlabels"))
+        client.add_constraint(
+            make_constraint(
+                "K8sRequiredLabels",
+                "sel-a",
+                params={"labels": [{"key": "x"}]},
+                match={"namespaceSelector": {"matchLabels": {"e": "p"}}},
+            )
+        )
+        client.add_constraint(
+            make_constraint(
+                "K8sRequiredLabels",
+                "plain",
+                params={"labels": [{"key": "x"}]},
+            )
+        )
+        client.add_constraint(
+            make_constraint(
+                "K8sRequiredLabels",
+                "sel-b",
+                params={"labels": [{"key": "x"}]},
+                match={"namespaceSelector": {"matchExpressions": [
+                    {"key": "e", "operator": "Exists"}
+                ]}},
+            )
+        )
+        # only one namespace cached: reviews in others autoreject
+        client.add_data(namespace("cached", labels={"e": "p"}))
+        return client
+
+    from gatekeeper_tpu.constraint import AugmentedReview
+
+    def adm(i):
+        ns = "cached" if i % 3 else "ghost"
+        return AugmentedReview(
+            {
+                "uid": f"u{i}",
+                "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "operation": "CREATE",
+                "name": f"p{i}",
+                "namespace": ns,
+                "userInfo": {"username": "t"},
+                "object": pod(f"p{i}", ns=ns),
+            }
+        )
+
+    objs = [adm(i) for i in range(24)]  # >= MIN_DEVICE_BATCH: device route
+    tpu_client = build(TpuDriver())
+    rego_client = build(RegoDriver())
+    got = tpu_client.review_many(objs)
+    for i, obj in enumerate(objs):
+        want = rego_client.review(obj).by_target[TARGET].results
+        assert canon(got[i].by_target[TARGET].results) == canon(want), i
+    # ghost-namespace reviews rejected by BOTH selector constraints
+    ghost = got[0].by_target[TARGET].results
+    rejected = [r for r in ghost if "not cached" in r.msg]
+    names = [(r.constraint.get("metadata") or {}).get("name")
+             for r in rejected]
+    assert names == ["sel-a", "sel-b"]
