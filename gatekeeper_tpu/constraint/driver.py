@@ -42,6 +42,20 @@ from .types import Response, Result
 _HOOK_RE = re.compile(r'^hooks\["([^"]+)"\]\.(violation|audit)$')
 
 
+def _autoreject_result(constraint: Dict[str, Any], review: Any) -> Result:
+    """The autoreject Result shape (client/regolib/src.go:7-21) — the ONE
+    definition shared by every evaluation path (serial interpreter,
+    adaptive small-batch, fused device batch): driver parity demands the
+    shape can never diverge between routes."""
+    return Result(
+        msg="Namespace is not cached in OPA.",
+        metadata={"details": {}},
+        constraint=constraint,
+        review=review,
+        enforcement_action=M.enforcement_action(constraint),
+    )
+
+
 class Driver(ABC):
     """Engine plugin interface (drivers/interface.go:21-39)."""
 
@@ -232,15 +246,7 @@ class RegoDriver(Driver):
         results: List[Result] = []
         for constraint in constraints:
             if M.autoreject(constraint, review, ns_cache):
-                results.append(
-                    Result(
-                        msg="Namespace is not cached in OPA.",
-                        metadata={"details": {}},
-                        constraint=constraint,
-                        review=review,
-                        enforcement_action=M.enforcement_action(constraint),
-                    )
-                )
+                results.append(_autoreject_result(constraint, review))
                 if trace is not None:
                     trace.append(f"autoreject: {_cname(constraint)}")
         for constraint in constraints:

@@ -61,7 +61,7 @@ from ..rego import ast as A
 from ..rego.interp import RegoError, Undefined, _call_function
 from ..rego.values import freeze, thaw
 from . import match as M
-from .driver import RegoDriver, _cname
+from .driver import RegoDriver, _autoreject_result, _cname
 from .types import Response, Result
 
 _TEMPLATE_PREFIX_RE = re.compile(r'^templates\["([^"]+)"\]\["([^"]+)"\]$')
@@ -87,18 +87,6 @@ MIN_DEVICE_BATCH = 12
 
 def _params_key(params: Any) -> str:
     return json.dumps(params, sort_keys=True, default=str)
-
-
-def _autoreject_result(constraint: Dict[str, Any], review: Any) -> Result:
-    """The autoreject Result shape (client/regolib/src.go:7-21) — one
-    definition shared by the serial and batched paths (driver parity)."""
-    return Result(
-        msg="Namespace is not cached in OPA.",
-        metadata={"details": {}},
-        constraint=constraint,
-        review=review,
-        enforcement_action=M.enforcement_action(constraint),
-    )
 
 
 _CACHE_ENABLED = False

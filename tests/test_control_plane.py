@@ -626,3 +626,49 @@ def test_debug_profiler_endpoint():
         assert exc.value.code == 404
     finally:
         off.stop()
+
+
+def test_full_stack_with_tpu_driver():
+    """The whole plane — ingestion, readiness, webhook, audit — over the
+    compiled TpuDriver engine (other control-plane tests use the
+    interpreter engine for speed)."""
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    cluster = FakeCluster()
+    cluster.apply(template("K8sRequiredLabels", REQ_LABELS))
+    cluster.apply(
+        constraint(
+            "K8sRequiredLabels", "need-owner", params={"labels": ["owner"]}
+        )
+    )
+    cluster.apply(config())
+    cluster.apply(pod("good", labels={"owner": "me"}))
+    cluster.apply(pod("bad"))
+    client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    runner = Runner(cluster, client, TARGET, audit_interval=3600)
+    runner.start()
+    try:
+        assert runner.wait_ready(60), runner.tracker.stats()
+        report = runner.audit.audit()
+        assert report.total_violations == 1
+        assert report.statuses["K8sRequiredLabels/need-owner"].violations[
+            0
+        ].name == "bad"
+        resp = runner.webhook.handler.handle(
+            {
+                "uid": "t1",
+                "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "operation": "CREATE",
+                "name": "x",
+                "namespace": "default",
+                "userInfo": {"username": "dev"},
+                "object": pod("x"),
+            }
+        )
+        assert resp.allowed is False and "need-owner" in resp.message
+        # churn through the compiled engine: new data invalidates caches
+        cluster.apply(pod("bad2"))
+        runner.watch_mgr.wait_idle()
+        assert runner.audit.audit().total_violations == 2
+    finally:
+        runner.stop()
