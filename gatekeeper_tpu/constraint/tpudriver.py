@@ -469,15 +469,24 @@ class TpuDriver(RegoDriver):
     ) -> Dict[str, np.ndarray]:
         """Per-row screen refinement bits for inventory join templates.
 
-        "invdup:<pattern>" semantics (sound over-approximations of the
-        uniqueness-join truth):
-          * persistent audit corpus (reviews ARE the inventory): the
-            row holds a value at <pattern> carried by >=2 distinct rows
-            — a key carried only by its own object can never conflict
-            (the identical() exclusion);
+        "invdup:<leaf>:<mirror>:<se>:<guards>" semantics (sound
+        over-approximations of the join truth; encoding produced by
+        symbolic.Compiler._compile_clause):
+          * the row's candidate values are its tokens at the LEAF
+            pattern; partners are counted at the MIRROR pattern (the
+            partner-side path proved by symbolic._mirror_pattern_for —
+            same pattern for self-joins, a "?"-generalized one when the
+            inventory walk iterates vars);
+          * persistent audit corpus (reviews ARE the inventory): a
+            value carried by >=2 distinct rows at the mirror pattern
+            can conflict. The threshold 2 is only sound when <se>=1 (a
+            proven `not identical(obj, input.review)` guard excludes
+            the self-partner) AND the row carries every <guards>
+            identity field the proof needs (rows missing one can join
+            themselves); otherwise the threshold drops to 1;
           * ephemeral review batch (webhook): the row holds a value at
-            <pattern> present ANYWHERE in the synced inventory (the
-            identical() exclusion is re-checked exactly by the
+            the leaf pattern present ANYWHERE in the synced inventory
+            at the mirror pattern (exclusions re-checked exactly by the
             interpreter render).
         """
         if corpus.row_feats is None:
@@ -488,24 +497,29 @@ class TpuDriver(RegoDriver):
             if cached is not None:
                 out[name] = cached
                 continue
-            pid = int(name.split(":", 1)[1])
+            parts = name.split(":")
+            leaf_pid, mirror_pid = int(parts[1]), int(parts[2])
+            self_excl = parts[3] == "1"
+            gpids = [int(x) for x in parts[4].split("+") if x]
             base = corpus
             if corpus.data_gen >= 0:
-                counts, inv_fb = self._pattern_value_counts(corpus, pid)
+                counts, inv_fb = self._pattern_value_counts(
+                    corpus, mirror_pid
+                )
                 # a fallback (token-overflow) row's keys are invisible
                 # to the counts: its partner would see count 1 — drop
                 # the threshold so single-count carriers still route
-                thresh = 1 if inv_fb else 2
+                thresh = 2 if (self_excl and not inv_fb) else 1
             else:
                 with_inv = self._audit_corpus(target)
                 if with_inv is None:
                     counts, inv_fb = None, False
                 else:
                     counts, inv_fb = self._pattern_value_counts(
-                        with_inv, pid
+                        with_inv, mirror_pid
                     )
                 thresh = 1
-            sel, vids = self._pattern_tokens(base, pid)
+            sel, vids = self._pattern_tokens(base, leaf_pid)
             if counts is None:
                 feat = np.zeros(len(base.reviews), bool)
             elif inv_fb and corpus.data_gen < 0:
@@ -517,6 +531,14 @@ class TpuDriver(RegoDriver):
                 safe = np.minimum(np.maximum(vids, 0), dup.shape[0] - 1)
                 hit = sel & (vids >= 0) & (vids < dup.shape[0]) & dup[safe]
                 feat = hit.any(axis=1)
+                if corpus.data_gen >= 0 and thresh >= 2 and gpids:
+                    # rows missing a guard identity field void the
+                    # self-exclusion proof: keep them routed
+                    has_all = np.ones(len(base.reviews), bool)
+                    for gp in gpids:
+                        gsel, gvids = self._pattern_tokens(base, gp)
+                        has_all &= (gsel & (gvids >= 0)).any(axis=1)
+                    feat |= ~has_all
             # fallback rows (overflow etc.) must stay routed
             feat |= np.asarray(base.row_fallback, bool)
             corpus.row_feats[name] = feat
@@ -559,7 +581,11 @@ class TpuDriver(RegoDriver):
     def _redispatch_chunk(self, policy, corpus: _Corpus, stacked, ci: int,
                           n_hot: int):
         """Overflow path: one chunk had more violating rows than the
-        compaction window — rerun just that chunk with room."""
+        compaction window — rerun just that chunk with room. The row
+        feature planes ride along (ADVICE r3: dropping them widens the
+        screen, so the rerun could flag more hot rows than the refined
+        n_hot the cap was sized from); the cap still doubles until the
+        rerun's own n_hot fits, so no hot row is ever truncated."""
         from ..parallel.sharding import StagedBatch
 
         r_cap = 1 << (n_hot - 1).bit_length()
@@ -570,9 +596,16 @@ class TpuDriver(RegoDriver):
             n_valid=stacked.n_valids[ci],
             key=("chunkview", stacked.key, stacked.chunk),
         )
-        return self.kernel.dispatch_need(
-            policy, batch, corpus.g, r_cap=r_cap
-        )
+        row_in = {
+            k: v[ci] for k, v in (stacked.row_dev or {}).items()
+        }
+        while True:
+            out = self.kernel.dispatch_need(
+                policy, batch, corpus.g, r_cap=r_cap, row_in=row_in
+            )
+            if out[2] <= min(r_cap, stacked.chunk):
+                return out
+            r_cap = min(2 * r_cap, stacked.chunk)
 
     def _need_pairs_np(self, cs, corpus, ns_cache, n):
         """Numpy path (use_jax=False): same pair semantics, eager host

@@ -30,7 +30,7 @@ from ..flatten.encoder import esc_seg, unesc_seg
 
 @dataclass(frozen=True)
 class Pattern:
-    segs: Tuple[str, ...]  # literal | "#" | "*" | "**" (final only)
+    segs: Tuple[str, ...]  # literal | "#" | "*" | "?" | "**" (final only)
 
     @property
     def key(self) -> Tuple[str, ...]:
@@ -42,8 +42,11 @@ def _match(pattern: Tuple[str, ...], segs: List[str]) -> Tuple[bool, Optional[st
 
     "*" matches exactly one OBJECT-KEY segment (never the "#" array
     marker — object and array iteration branches must stay disjoint);
-    "#" matches exactly the array marker; "**" (final position) matches
-    any remaining suffix including the empty one.
+    "#" matches exactly the array marker; "?" matches exactly one
+    segment of ANY kind (array marker or key, no capture — used by
+    inventory-join mirror patterns where the partner's structure is
+    unknown); "**" (final position) matches any remaining suffix
+    including the empty one.
     """
     cap: Optional[str] = None
     pi = 0
@@ -58,6 +61,8 @@ def _match(pattern: Tuple[str, ...], segs: List[str]) -> Tuple[bool, Optional[st
                 return False, None
             if cap is None:
                 cap = seg
+        elif p == "?":
+            pass
         elif p == "#":
             if seg != "#":
                 return False, None
@@ -158,3 +163,6 @@ class PatternRegistry:
     @property
     def n_patterns(self) -> int:
         return len(self._patterns)
+
+    def segs(self, idx: int) -> Tuple[str, ...]:
+        return self._patterns[idx].segs

@@ -169,13 +169,27 @@ class SInput(SVal):
     """The bare `input` document (proc-mount passes it to a helper)."""
 
 
+@dataclass
 class SInventory(SVal):
     """Opaque value: walks and calls propagate it; any condition on it
     raises InventoryDependent (see that class). Produced by
     `data.inventory` refs always, and — in screen mode — by calls and
     comprehensions outside the compilable subset (a flatten_selector-
     style derived string whose only use is an inventory comparison needs
-    no device value at all)."""
+    no device value at all).
+
+    `path` tracks the walked segments from the data.inventory root —
+    escaped literal keys, "#" for literal array indices, "?" for
+    var-iterated (unknown) segments; None once the value flowed through
+    a call/comprehension and the path is unknowable. `root` identifies
+    the inventory iteration the value descends from, so self-exclusion
+    guards (`not identical(other, input.review)`) can be tied to the
+    join they guard. Both exist solely so the invdup screen refinement
+    can prove its soundness conditions (ADVICE r3 high: a cross-path
+    join refined at the review leaf's own pattern under-approximates)."""
+
+    path: Optional[Tuple[str, ...]] = None
+    root: int = -1
 
 
 @dataclass
@@ -398,6 +412,93 @@ def _space_join(a: Tuple[str, ...], b: Tuple[str, ...]) -> Tuple[str, ...]:
     return j
 
 
+def _is_review_ref(term: A.Term, st: "State") -> bool:
+    """Is this term the review document (`input.review` or a var bound
+    to it)? Used when matching the self-exclusion guard idiom."""
+    if (
+        isinstance(term, A.Ref)
+        and isinstance(term.head, A.Var)
+        and term.head.name == "input"
+        and len(term.ops) == 1
+        and isinstance(term.ops[0], A.Scalar)
+        and term.ops[0].value == "review"
+    ):
+        return True
+    if isinstance(term, A.Var):
+        v = st.env.get(term.name)
+        return isinstance(v, SNode) and v.prefix == ()
+    return False
+
+
+def _self_identity_paths(
+    rule: A.Rule,
+) -> Optional[Tuple[Tuple[str, ...], ...]]:
+    """If this function definition is provably TRUE whenever its first
+    argument IS the second argument's `.object` (and the compared
+    fields are defined), return the object paths whose definedness that
+    proof needs; else None.
+
+    Accepted shape — every body statement equates obj.<p...> with
+    review.object.<p...> over the same all-scalar path (either operand
+    order): when obj is review.object both sides are the same value, so
+    each equality holds iff the path is defined."""
+    head = rule.head
+    if (
+        head.kind != "func"
+        or not head.args
+        or len(head.args) != 2
+        or rule.is_default
+        or rule.else_rule is not None
+        or not isinstance(head.args[0], A.Var)
+        or not isinstance(head.args[1], A.Var)
+        or not rule.body
+    ):
+        return None
+    obj_name, rev_name = head.args[0].name, head.args[1].name
+    paths: List[Tuple[str, ...]] = []
+    for expr in rule.body:
+        if (
+            isinstance(expr, A.TermExpr)
+            and isinstance(expr.term, A.BinOp)
+            and expr.term.op == "=="
+        ):
+            lhs, rhs = expr.term.lhs, expr.term.rhs
+        elif isinstance(expr, A.Unify):
+            lhs, rhs = expr.lhs, expr.rhs
+        else:
+            return None
+        p1 = _scalar_path(lhs, obj_name)
+        p2 = _scalar_path(rhs, rev_name)
+        if p1 is None or p2 is None:
+            p1 = _scalar_path(rhs, obj_name)
+            p2 = _scalar_path(lhs, rev_name)
+        if p1 is None or p2 is None:
+            return None
+        if p2[:1] != ("object",) or p2[1:] != p1:
+            return None
+        paths.append(p1)
+    return tuple(paths)
+
+
+def _scalar_path(
+    term: A.Term, base_name: str
+) -> Optional[Tuple[str, ...]]:
+    """`base.<a>.<b>...` with all-scalar-string ops -> ("a", "b", ...)."""
+    if (
+        not isinstance(term, A.Ref)
+        or not isinstance(term.head, A.Var)
+        or term.head.name != base_name
+    ):
+        return None
+    segs: List[str] = []
+    for op in term.ops:
+        if isinstance(op, A.Scalar) and isinstance(op.value, str):
+            segs.append(op.value)
+        else:
+            return None
+    return tuple(segs)
+
+
 class Compiler:
     """Compiles one template's violation rules for one concrete params."""
 
@@ -435,10 +536,14 @@ class Compiler:
         # hold an array) — rows breaking the assumption route to the
         # interpreter instead of silently evaluating wrong
         self._force_flags: List[Expr] = []
-        # pattern ids of review-side leaves equality-joined against
-        # inventory content in the clause being compiled (screen
-        # refinement; see _apply_binop)
-        self._clause_joins: List[int] = []
+        # (leaf pattern id, mirror pattern id, inventory root id) of
+        # review-side leaves equality-joined against inventory content
+        # in the clause being compiled (screen refinement; _apply_binop)
+        self._clause_joins: List[Tuple[int, int, int]] = []
+        # (inventory root id, guard pattern ids) for detected
+        # self-exclusion guards (`not identical(obj, input.review)`)
+        self._clause_guards: List[Tuple[int, Tuple[int, ...]]] = []
+        self._inv_root_n = 0  # fresh ids for inventory iterations
         self.row_features: List[str] = []  # features programs consume
 
     def _pattern(self, segs: Tuple[str, ...]) -> int:
@@ -490,6 +595,7 @@ class Compiler:
     ) -> List[Tuple[Any, Tuple[str, ...], Expr]]:
         flags_base = len(self._force_flags)
         joins_base = len(self._clause_joins)
+        guards_base = len(self._clause_guards)
         finals = self._eval_body(rule.body, State(env={}))
         # safety flags raised during this clause's evaluation OR into
         # every branch: flagged rows always route to the interpreter
@@ -501,12 +607,22 @@ class Compiler:
         # default True so the screen degrades to coarse, never unsound)
         clause_joins = sorted(set(self._clause_joins[joins_base:]))
         del self._clause_joins[joins_base:]
+        guards_map: Dict[int, Tuple[int, ...]] = {}
+        for root, gpids in self._clause_guards[guards_base:]:
+            guards_map.setdefault(root, gpids)
+        del self._clause_guards[guards_base:]
         join_refine: Optional[Expr] = None
         if clause_joins:
             from .exprs import ERowFeature
 
-            for pid in clause_joins:
-                feat_name = f"invdup:{pid}"
+            for leaf_pid, mirror_pid, root in clause_joins:
+                # feature encoding consumed by the dispatch layer
+                # (TpuDriver._row_feature_bits):
+                # invdup:<leaf>:<mirror>:<self-excluded 0|1>:<g+g+...>
+                gpids = guards_map.get(root)
+                se = 1 if gpids else 0
+                gstr = "+".join(str(g) for g in (gpids or ()))
+                feat_name = f"invdup:{leaf_pid}:{mirror_pid}:{se}:{gstr}"
                 if feat_name not in self.row_features:
                     self.row_features.append(feat_name)
                     self.signature.append(("rowfeat", feat_name))
@@ -766,7 +882,14 @@ class Compiler:
     def _eval_not(self, inner: A.Expr, st: State) -> List[State]:
         sub = State(env=dict(st.env), space=st.space, guards=dict(st.guards), axis_owner=dict(st.axis_owner))
         with self._inv_barrier():
-            finals = self._eval_body([inner], sub)
+            try:
+                finals = self._eval_body([inner], sub)
+            except InventoryDependent:
+                # the whole `not` conjunct is about to drop; if it is a
+                # self-exclusion guard, record it for the invdup
+                # refinement before the exception propagates
+                self._note_self_exclusion(inner, st)
+                raise
         if not finals:
             return [st]  # statically undefined -> `not` succeeds
         exprs = []
@@ -924,7 +1047,12 @@ class Compiler:
                 # and conditions on it drop (InventoryDependent); walking
                 # with unbound vars binds them opaquely too
                 self.uses_inventory = True
-                return self._walk(SInventory(), ref.ops[1:], st)
+                self._inv_root_n += 1
+                return self._walk(
+                    SInventory(path=(), root=self._inv_root_n),
+                    ref.ops[1:],
+                    st,
+                )
             raise CompileUnsupported("data ref outside inventory")
         raise CompileUnsupported(f"unknown ref head {name}")
 
@@ -942,12 +1070,40 @@ class Compiler:
     def _walk_one(self, val: SVal, op: A.Term, st: State):
         if isinstance(val, SInventory):
             # any step stays opaque; unbound var keys (ns/name/apiversion
-            # iteration) bind opaquely
-            if isinstance(op, A.Var) and op.name not in st.env:
-                env = dict(st.env)
-                env[op.name] = SInventory()
-                return [(SInventory(), replace(st, env=env))]
-            return [(SInventory(), st)]
+            # iteration) bind opaquely. The walked segment is tracked on
+            # the result so inventory joins can prove their counting
+            # pattern mirrors the partner's real path (esc-literal / "#"
+            # for literal array indices / "?" where the segment is
+            # unknowable at compile time).
+            seg: Optional[str] = None
+            if isinstance(op, A.Scalar):
+                if isinstance(op.value, str):
+                    seg = esc_seg(op.value)
+                elif isinstance(op.value, (int, float)) and not isinstance(
+                    op.value, bool
+                ):
+                    seg = "#"
+            elif isinstance(op, A.Wildcard):
+                seg = "?"
+            elif isinstance(op, A.Var):
+                bound = st.env.get(op.name)
+                if bound is None:
+                    env = dict(st.env)
+                    env[op.name] = SInventory()
+                    st = replace(st, env=env)
+                    seg = "?"
+                elif isinstance(bound, SConst) and isinstance(
+                    bound.value, str
+                ):
+                    seg = esc_seg(bound.value)
+                else:
+                    seg = "?"
+            path = (
+                None
+                if (val.path is None or seg is None)
+                else val.path + (seg,)
+            )
+            return [(SInventory(path=path, root=val.root), st)]
         if isinstance(val, SInput):
             if isinstance(op, A.Scalar) and op.value == "parameters":
                 return [(SConst(self.params), st)]
@@ -960,7 +1116,33 @@ class Compiler:
             return self._walk_node(val, op, st)
         if isinstance(val, (SScalar, SKey, SMsg, SDerived)):
             # indexing into a leaf: undefined in Rego (object-branch values
-            # walked further also land here and contribute nothing)
+            # walked further also land here and contribute nothing). But an
+            # object-ITERATION element (tok_space over prefix.*.**) may hold
+            # structure on some rows — those rows' deeper walks are real in
+            # Rego, so raise a row-level safety flag routing exactly the
+            # rows that have matching deeper tokens to the interpreter
+            # (found via the mixed-structure partner differential test:
+            # spec.rules as an object map where the template iterates it).
+            if (
+                isinstance(val, SScalar)
+                and val.tok_space
+                and val.pattern_idx >= 0
+            ):
+                segs = self.patterns.segs(val.pattern_idx)
+                if segs and segs[-1] == "**":
+                    flag_segs = None
+                    if isinstance(op, A.Scalar) and isinstance(
+                        op.value, str
+                    ):
+                        flag_segs = segs[:-1] + (esc_seg(op.value), "**")
+                    elif isinstance(op, (A.Var, A.Wildcard)):
+                        flag_segs = segs[:-1] + ("?", "**")
+                    if flag_segs is not None:
+                        flag_pat = self._pattern(flag_segs)
+                        self._force_flags.append(
+                            EReduce(ESelPattern(flag_pat), "any")
+                        )
+                        self.uses_inventory = True
             return []
         if isinstance(val, STokenSet):
             if isinstance(op, (A.Var, A.Wildcard)) and not (
@@ -1622,6 +1804,7 @@ class Compiler:
             # clause would wrongly screen forks that can violate without
             # the join (those constructs run under the _inv_barrier).
             if op == "==" and self._no_inv_catch == 0:
+                inv = lv if isinstance(lv, SInventory) else rv
                 other = rv if isinstance(lv, SInventory) else lv
                 try:
                     leaf = self._leafify(other)
@@ -1632,8 +1815,15 @@ class Compiler:
                     and leaf.pattern_idx >= 0
                     and leaf.num_override is None
                     and leaf.vid_override is None
+                    and isinstance(inv, SInventory)
                 ):
-                    self._clause_joins.append(leaf.pattern_idx)
+                    mirror = self._mirror_pattern_for(
+                        inv, leaf.pattern_idx
+                    )
+                    if mirror is not None:
+                        self._clause_joins.append(
+                            (leaf.pattern_idx, mirror, inv.root)
+                        )
             raise InventoryDependent()
         if isinstance(lv, SConst) and isinstance(rv, SConst):
             return self._const_binop(op, lv, rv, st)
@@ -1650,6 +1840,100 @@ class Compiler:
         if op in ("&", "|"):
             raise CompileUnsupported("symbolic set intersection/union")
         raise CompileUnsupported(f"binop {op}")
+
+    def _mirror_pattern_for(
+        self, inv: "SInventory", leaf_pid: int
+    ) -> Optional[int]:
+        """The partner-side counting pattern for an inventory equality
+        join, or None when the refinement must be skipped (ADVICE r3
+        high: refining a cross-path join at the review leaf's own
+        pattern under-approximates and misses violations).
+
+        Sound iff every concrete partner token path consistent with the
+        walk matches the returned pattern AND the leaf's own pattern is
+        a sub-pattern of it (so the row self-counts, keeping the
+        duplicate threshold meaningful). That holds when the walk
+        addresses an object root (data.inventory.namespace[.][.][.][.]
+        or .cluster[.][.][.]) and the remaining segments positionally
+        mirror the leaf pattern: equal literals, or "?" (var-iterated —
+        "?" matches ANY one segment, so it covers both the partner's
+        real structure and the leaf's "#"/"*" position)."""
+        if inv.path is None or not inv.path:
+            return None
+        if inv.path[0] == "namespace" and len(inv.path) >= 5:
+            obj = inv.path[5:]
+        elif inv.path[0] == "cluster" and len(inv.path) >= 4:
+            obj = inv.path[4:]
+        else:
+            return None
+        psegs = self.patterns.segs(leaf_pid)
+        # partners are inventory objects encoded as synthesized reviews,
+        # so their tokens live under the "object" root; a leaf outside
+        # it (e.g. oldObject) cannot self-count — skip
+        if not psegs or psegs[0] != "object":
+            return None
+        body = psegs[1:]
+        if len(body) != len(obj):
+            return None
+        mirror: List[str] = ["object"]
+        for p, m in zip(body, obj):
+            if m == "?":
+                mirror.append("?")
+            elif p == m and p not in ("*", "?", "**"):
+                mirror.append(m)
+            else:
+                return None
+        if tuple(mirror) == tuple(psegs):
+            return leaf_pid
+        return self._pattern(tuple(mirror))
+
+    def _note_self_exclusion(self, inner: A.Expr, st: State) -> None:
+        """Detect the uniqueness-template self-exclusion idiom
+        `not identical(<inventory obj>, input.review)` (reference:
+        library/general/uniqueingresshost/src.rego identical/2) while
+        its InventoryDependent escapes the negation barrier.
+
+        Without a proven self-exclusion an object can join with ITSELF
+        (it is part of the synced inventory), so "key carried by >=2
+        distinct rows" no longer bounds violations and the refinement
+        threshold must drop to 1. Records (inventory root, guard
+        pattern ids) — the guard paths are the identity fields the
+        proof needs DEFINED on the row (an object missing one, e.g.
+        metadata.namespace on a cluster-scoped kind, makes identical()
+        undefined and the exclusion void for that row)."""
+        if not isinstance(inner, A.TermExpr) or not isinstance(
+            inner.term, A.Call
+        ):
+            return
+        call = inner.term
+        if call.name not in self.rules or len(call.args) != 2:
+            return
+        a0 = call.args[0]
+        if not isinstance(a0, A.Var):
+            return
+        inv = st.env.get(a0.name)
+        if not isinstance(inv, SInventory) or inv.path is None:
+            return
+        rootlen = (
+            5 if inv.path[:1] == ("namespace",)
+            else 4 if inv.path[:1] == ("cluster",)
+            else -1
+        )
+        if rootlen < 0 or len(inv.path) != rootlen:
+            return
+        if not _is_review_ref(call.args[1], st):
+            return
+        for rule in self.rules[call.name]:
+            gpaths = _self_identity_paths(rule)
+            if gpaths is not None:
+                gpids = tuple(
+                    self._pattern(
+                        ("object",) + tuple(esc_seg(s) for s in gp)
+                    )
+                    for gp in gpaths
+                )
+                self._clause_guards.append((inv.root, gpids))
+                return
 
     def _const_binop(self, op: str, lv: SConst, rv: SConst, st: State):
         from ..rego.values import freeze, rego_cmp

@@ -540,6 +540,7 @@ class FusedAuditKernel:
         g: int,
         block: bool = True,
         r_cap: int = 4096,
+        row_in: Optional[Dict[str, Any]] = None,
     ) -> Tuple[Any, Any, Any, Any, Any]:
         """-> (packed hot-row need bits [C_pad x R / 8] uint8 c-major,
         hot row ids [R] int32, n_hot, compiled_pairs, interp_pairs) for
@@ -565,7 +566,9 @@ class FusedAuditKernel:
         """
         n_pad = batch.tok_dev["spath"].shape[0]
         r_cap = min(r_cap, n_pad)
-        key = ("need", policy.key, batch.key, g, r_cap)
+        row_in = row_in or {}
+        key = ("need", policy.key, batch.key, g, r_cap,
+               tuple(sorted(row_in)))
         entry = self._jit_cache.get(key)
         if entry is None:
             run_need = self._need_chunk_fn(policy, g, r_cap)
@@ -582,6 +585,7 @@ class FusedAuditKernel:
             policy.compiled_mask,
             batch.row_fb,
             jnp.int32(batch.n_valid),
+            row_in,
         )
         if not block:
             return out

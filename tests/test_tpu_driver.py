@@ -444,6 +444,129 @@ def test_inventory_join_screen_is_sharp():
     assert int(bits.sum()) == 2  # only the 2 dup carriers flagged
 
 
+def test_cross_path_inventory_join_parity():
+    """A review leaf equality-joined against inventory content at a
+    DIFFERENT path (ADVICE r3 high): the invdup refinement must not be
+    recorded (counts at the leaf's own pattern see count 1 and would
+    screen the row out), so the coarse screen routes the row and the
+    interpreter reports the violation."""
+    rego = """package crosspath
+
+violation[{"msg": "uses an existing priority class"}] {
+    input.review.object.spec.priorityClassName == data.inventory.cluster[_]["PriorityClass"][name].metadata.name
+}
+"""
+    tmpl = {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "crosspath"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "CrossPath"}}},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    }
+
+    def build(driver):
+        client = Backend(driver).new_client(K8sValidationTarget())
+        client.add_template(tmpl)
+        client.add_constraint(make_constraint("CrossPath", "cp"))
+        client.add_data(
+            {
+                "apiVersion": "scheduling.k8s.io/v1",
+                "kind": "PriorityClass",
+                "metadata": {"name": "high"},
+            }
+        )
+        # the pod's priorityClassName value appears exactly once at its
+        # own leaf pattern — a same-path refinement would screen it out
+        client.add_data(
+            pod("p1", spec_extra={"priorityClassName": "high"})
+        )
+        client.add_data(namespace("default"))
+        return client
+
+    want = canon(build(RegoDriver()).audit().by_target[TARGET].results)
+    got = canon(build(TpuDriver()).audit().by_target[TARGET].results)
+    assert got == want
+    assert len(want) == 1  # p1 violates via the cross-path join
+
+
+def test_self_join_without_identical_guard_parity():
+    """A uniqueness-style join WITHOUT the `not identical(...)` guard:
+    every synced object joins with ITSELF, so a cluster-unique key must
+    not be screened out (the duplicate threshold of 2 is only sound
+    under a proven self-exclusion)."""
+    rego = """package selfjoin
+
+violation[{"msg": "host exists in inventory"}] {
+    input.review.object.spec.host == data.inventory.namespace[_][_]["Widget"][_].spec.host
+}
+"""
+    tmpl = {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "selfjoin"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "SelfJoin"}}},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    }
+
+    def build(driver):
+        client = Backend(driver).new_client(K8sValidationTarget())
+        client.add_template(tmpl)
+        client.add_constraint(make_constraint("SelfJoin", "sj"))
+        client.add_data(
+            {
+                "apiVersion": "v1",
+                "kind": "Widget",
+                "metadata": {"name": "w1", "namespace": "d"},
+                "spec": {"host": "only-mine.example"},
+            }
+        )
+        return client
+
+    want = canon(build(RegoDriver()).audit().by_target[TARGET].results)
+    got = canon(build(TpuDriver()).audit().by_target[TARGET].results)
+    assert got == want
+    assert len(want) == 1  # w1 joins itself: unique key still violates
+
+
+def test_mixed_structure_partner_parity():
+    """A join partner whose iterated level is an OBJECT where the review
+    rows have an ARRAY: the mirror pattern's "?" segment must count it
+    (a leaf-pattern "#" count would miss it and screen the array row
+    out)."""
+
+    def ing(name, ns, rules):
+        return {
+            "apiVersion": "networking.k8s.io/v1beta1",
+            "kind": "Ingress",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"rules": rules},
+        }
+
+    def build(driver):
+        client = Backend(driver).new_client(K8sValidationTarget())
+        client.add_template(
+            load_template(f"{LIB}/general/uniqueingresshost")
+        )
+        client.add_constraint(
+            make_constraint("K8sUniqueIngressHost", "u")
+        )
+        client.add_data(ing("arr", "n1", [{"host": "dup.example"}]))
+        # object-map rules: [_] iterates its values in Rego
+        client.add_data(
+            ing("obj", "n2", {"r1": {"host": "dup.example"}})
+        )
+        client.add_data(ing("solo", "n1", [{"host": "solo.example"}]))
+        return client
+
+    want = canon(build(RegoDriver()).audit().by_target[TARGET].results)
+    got = canon(build(TpuDriver()).audit().by_target[TARGET].results)
+    assert got == want
+
+
 def test_join_refine_not_applied_across_helper_definitions():
     """An inventory equality inside ONE definition of a multi-definition
     helper must NOT screen out forks satisfiable via the other
