@@ -886,9 +886,13 @@ class Compiler:
                 finals = self._eval_body([inner], sub)
             except InventoryDependent:
                 # the whole `not` conjunct is about to drop; if it is a
-                # self-exclusion guard, record it for the invdup
-                # refinement before the exception propagates
-                self._note_self_exclusion(inner, st)
+                # CLAUSE-LEVEL self-exclusion guard, record it for the
+                # invdup refinement before the exception propagates.
+                # Depth 1 = only this `not`'s own barrier is active; a
+                # deeper nesting (comprehension/function body) inverts
+                # or launders polarity, so the guard cannot be trusted
+                if self._no_inv_catch == 1:
+                    self._note_self_exclusion(inner, st)
                 raise
         if not finals:
             return [st]  # statically undefined -> `not` succeeds
@@ -1135,7 +1139,9 @@ class Compiler:
                         op.value, str
                     ):
                         flag_segs = segs[:-1] + (esc_seg(op.value), "**")
-                    elif isinstance(op, (A.Var, A.Wildcard)):
+                    else:
+                        # var/wildcard iteration, numeric/bool indexing:
+                        # any one deeper segment voids the leaf read
                         flag_segs = segs[:-1] + ("?", "**")
                     if flag_segs is not None:
                         flag_pat = self._pattern(flag_segs)
@@ -1869,8 +1875,10 @@ class Compiler:
         psegs = self.patterns.segs(leaf_pid)
         # partners are inventory objects encoded as synthesized reviews,
         # so their tokens live under the "object" root; a leaf outside
-        # it (e.g. oldObject) cannot self-count — skip
-        if not psegs or psegs[0] != "object":
+        # it (e.g. oldObject) cannot self-count — skip. A "**" leaf
+        # matches variable depth, which no fixed-length mirror covers
+        # (the row would not self-count at depths the mirror misses).
+        if not psegs or psegs[0] != "object" or "**" in psegs:
             return None
         body = psegs[1:]
         if len(body) != len(obj):

@@ -567,6 +567,56 @@ def test_mixed_structure_partner_parity():
     assert got == want
 
 
+def test_numeric_index_into_iterated_object_value_parity():
+    """Walking an object-iteration element with a NUMERIC index
+    (`thing[_][0]`) must flag rows whose element is an array — the
+    compiled walk cannot represent it, so those rows route to the
+    interpreter instead of being silently screened out."""
+    rego = """package numidx
+
+violation[{"msg": "first element is bad"}] {
+    x := input.review.object.spec.thing[_]
+    x[0] == "bad"
+}
+"""
+    tmpl = {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "numidx"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "NumIdx"}}},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    }
+
+    def build(driver):
+        client = Backend(driver).new_client(K8sValidationTarget())
+        client.add_template(tmpl)
+        client.add_constraint(make_constraint("NumIdx", "ni"))
+        client.add_data(
+            {
+                "apiVersion": "v1",
+                "kind": "Widget",
+                "metadata": {"name": "w1", "namespace": "d"},
+                "spec": {"thing": {"k": ["bad", "x"]}},
+            }
+        )
+        client.add_data(
+            {
+                "apiVersion": "v1",
+                "kind": "Widget",
+                "metadata": {"name": "w2", "namespace": "d"},
+                "spec": {"thing": {"k": ["fine"]}},
+            }
+        )
+        return client
+
+    want = canon(build(RegoDriver()).audit().by_target[TARGET].results)
+    got = canon(build(TpuDriver()).audit().by_target[TARGET].results)
+    assert got == want
+    assert len(want) == 1  # w1 violates via thing.k[0] == "bad"
+
+
 def test_join_refine_not_applied_across_helper_definitions():
     """An inventory equality inside ONE definition of a multi-definition
     helper must NOT screen out forks satisfiable via the other
