@@ -130,18 +130,18 @@ class Client:
             if cached is not None and _template_equal(cached.template, ct):
                 resp.handled[target] = True
                 return resp
+            if cached is not None and cached.crd.kind != crd.kind:
+                # case-variant kind rename (name==lowercase(kind) still
+                # holds): the retired kind's modules and constraints must
+                # not stay evaluatable
+                self._unmount_kind(cached.targets, cached.crd.kind)
+                self._constraints.pop((CONSTRAINT_GROUP, cached.crd.kind), None)
+                cached = None
             if cached is not None and cached.targets != [target]:
                 # re-targeted template update: unmount the old target's
                 # modules and constraint data (or they stay evaluatable),
                 # then re-home the cached constraints under the new target
-                for old in cached.targets:
-                    self._driver.delete_modules(
-                        f'templates["{old}"]["{cached.crd.kind}"]'
-                    )
-                    self._driver.delete_data(
-                        f"/constraints/{old}/cluster/{CONSTRAINT_GROUP}/"
-                        f"{cached.crd.kind}"
-                    )
+                self._unmount_kind(cached.targets, cached.crd.kind)
                 for subpath, c in self._constraints.get(
                     (CONSTRAINT_GROUP, cached.crd.kind), {}
                 ).items():
@@ -155,6 +155,16 @@ class Client:
             resp.handled[target] = True
         return resp
 
+    def _unmount_kind(self, targets, kind: str) -> None:
+        """Delete a constraint kind's template modules and constraint-data
+        subtree from the driver for every given target. Caller holds
+        self._lock."""
+        for target in targets:
+            self._driver.delete_modules(f'templates["{target}"]["{kind}"]')
+            self._driver.delete_data(
+                f"/constraints/{target}/cluster/{CONSTRAINT_GROUP}/{kind}"
+            )
+
     def remove_template(self, templ: Union[dict, ConstraintTemplate]) -> Responses:
         resp = Responses()
         ct = (
@@ -167,14 +177,9 @@ class Client:
             if entry is None:
                 return resp
             target = entry.targets[0]
-            prefix = f'templates["{target}"]["{entry.crd.kind}"]'
-            self._driver.delete_modules(prefix)
-            gk = (CONSTRAINT_GROUP, entry.crd.kind)
+            self._unmount_kind(entry.targets, entry.crd.kind)
             # the subtree delete covers every constraint of this kind
-            self._constraints.pop(gk, None)
-            self._driver.delete_data(
-                f"/constraints/{target}/cluster/{CONSTRAINT_GROUP}/{entry.crd.kind}"
-            )
+            self._constraints.pop((CONSTRAINT_GROUP, entry.crd.kind), None)
             del self._templates[ct.name]
             resp.handled[target] = True
         return resp

@@ -81,6 +81,7 @@ class TemplateController:
         switch: Optional[ControllerSwitch] = None,
         metrics=None,
         status=None,
+        constraint_controller: Optional["ConstraintController"] = None,
     ):
         self.client = client
         self.watch_mgr = watch_mgr
@@ -89,6 +90,7 @@ class TemplateController:
         self.switch = switch
         self.metrics = metrics
         self.status = status
+        self.constraint_controller = constraint_controller
         self._lock = threading.Lock()
         self._kinds: Dict[str, str] = {}  # template name -> constraint kind
         self.errors: Dict[str, str] = {}  # template name -> last error
@@ -126,18 +128,34 @@ class TemplateController:
 
     def _on_upsert(self, name: str, obj: dict) -> None:
         crd = self.client.create_crd(obj)
+        with self._lock:
+            old_kind = self._kinds.get(name)
         self.client.add_template(obj)
+        if old_kind is not None and old_kind != crd.kind:
+            # case-variant kind rename: add_template succeeded, so the
+            # retired kind's modules/constraints are unmounted — only now
+            # stop watching it and drop its controller-side state (a
+            # failed add_template must leave the old kind watched)
+            self._retire_kind(old_kind)
         with self._lock:
             self._kinds[name] = crd.kind
         # dynamic watch: constraints of this kind now flow to the
         # constraint controller (constrainttemplate_controller.go:458)
         self.constraint_registrar.add_watch(constraint_gvk(crd.kind))
 
+    def _retire_kind(self, kind: str) -> None:
+        self.constraint_registrar.remove_watch(constraint_gvk(kind))
+        if self.constraint_controller is not None:
+            # remove_watch delivers no DELETED events, so the constraint
+            # controller's status/metrics/readiness for the kind must be
+            # dropped explicitly
+            self.constraint_controller.drop_kind(kind)
+
     def _on_delete(self, name: str, obj: dict) -> None:
         with self._lock:
             kind = self._kinds.pop(name, None)
         if kind is not None:
-            self.constraint_registrar.remove_watch(constraint_gvk(kind))
+            self._retire_kind(kind)
         self.client.remove_template(obj)
         if self.tracker is not None:
             self.tracker.templates.cancel_expect(name)
@@ -214,20 +232,46 @@ class ConstraintController:
                 self.status.publish_constraint(
                     kind, name, status, ea, self.errors.get(key)
                 )
-        if self.metrics is not None:
-            # per-(enforcement_action, status) counts, with removed
-            # series reset to 0 so stale totals never linger
-            with self._lock:
-                counts: Dict[Tuple[str, str], int] = {}
-                for s_ea, s_st in self._series.values():
-                    counts[(s_ea, s_st)] = counts.get((s_ea, s_st), 0) + 1
-            for (s_ea, s_st) in {(ea, status), *counts}:
-                self.metrics.gauge(
-                    "constraints",
-                    counts.get((s_ea, s_st), 0),
-                    enforcement_action=s_ea,
-                    status=s_st,
-                )
+        self._report_gauges(extras=[(ea, status)])
+
+    def drop_kind(self, kind: str) -> None:
+        """Drop all controller-side state for a retired constraint kind
+        (template deleted or kind renamed). The kind's watch is already
+        gone, so no DELETED events will ever arrive for its constraints —
+        status, metric series, and readiness expectations must be cleared
+        here or they report the retired constraints as enforced forever."""
+        removed: list = []
+        with self._lock:
+            names = self._by_kind.pop(kind, set())
+            for name in names:
+                series = self._series.pop(f"{kind}/{name}", None)
+                if series is not None:
+                    removed.append(series)
+        for name in names:
+            self.errors.pop(f"{kind}/{name}", None)
+            if self.tracker is not None:
+                self.tracker.for_constraint_kind(kind).cancel_expect(name)
+            if self.status is not None:
+                self.status.delete_constraint(kind, name)
+        if removed:
+            self._report_gauges(extras=removed)
+
+    def _report_gauges(self, extras=()) -> None:
+        if self.metrics is None:
+            return
+        # per-(enforcement_action, status) counts, with removed series
+        # reset to 0 so stale totals never linger
+        with self._lock:
+            counts: Dict[Tuple[str, str], int] = {}
+            for s_ea, s_st in self._series.values():
+                counts[(s_ea, s_st)] = counts.get((s_ea, s_st), 0) + 1
+        for (s_ea, s_st) in {*extras, *counts}:
+            self.metrics.gauge(
+                "constraints",
+                counts.get((s_ea, s_st), 0),
+                enforcement_action=s_ea,
+                status=s_st,
+            )
 
 
 class SyncController:

@@ -136,6 +136,7 @@ class Runner:
             switch=self.switch,
             metrics=metrics,
             status=self.status_writer,
+            constraint_controller=self.constraint_controller,
         )
         self._template_registrar = self.watch_mgr.new_registrar(
             "template-controller", self.template_controller.sink

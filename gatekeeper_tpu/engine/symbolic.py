@@ -1134,7 +1134,6 @@ class Compiler:
             ):
                 segs = self.patterns.segs(val.pattern_idx)
                 if segs and segs[-1] == "**":
-                    flag_segs = None
                     if isinstance(op, A.Scalar) and isinstance(
                         op.value, str
                     ):
@@ -1143,12 +1142,11 @@ class Compiler:
                         # var/wildcard iteration, numeric/bool indexing:
                         # any one deeper segment voids the leaf read
                         flag_segs = segs[:-1] + ("?", "**")
-                    if flag_segs is not None:
-                        flag_pat = self._pattern(flag_segs)
-                        self._force_flags.append(
-                            EReduce(ESelPattern(flag_pat), "any")
-                        )
-                        self.uses_inventory = True
+                    flag_pat = self._pattern(flag_segs)
+                    self._force_flags.append(
+                        EReduce(ESelPattern(flag_pat), "any")
+                    )
+                    self.uses_inventory = True
             return []
         if isinstance(val, STokenSet):
             if isinstance(op, (A.Var, A.Wildcard)) and not (

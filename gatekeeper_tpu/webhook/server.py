@@ -97,7 +97,8 @@ class MicroBatcher:
             self._thread.start()
 
     def stop(self) -> None:
-        self._stop = True
+        with self._lock:
+            self._stop = True
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -113,9 +114,15 @@ class MicroBatcher:
     def submit(self, request: Dict[str, Any]) -> Future:
         fut: Future = Future()
         with self._lock:
-            self._pending.append((request, fut))
-            n = len(self._pending)
-        if n == 1 or n >= self.max_batch:
+            stopped = self._stop
+            if not stopped:
+                self._pending.append((request, fut))
+                n = len(self._pending)
+        if stopped:
+            # worker is gone (and stop() may have already drained its
+            # leftovers): dispatch inline so the caller never hangs
+            self._dispatch([(request, fut)])
+        elif n == 1 or n >= self.max_batch:
             self._wake.set()
         return fut
 

@@ -280,6 +280,44 @@ def test_template_delete_removes_constraints(booted):
     assert report.total_violations == 0
 
 
+def test_template_kind_rename_retires_old_kind(booted):
+    # case-variant rename keeps name==lowercase(kind) valid but changes
+    # the constraint GVK: the old kind's watch and constraints must be
+    # torn down (controllers.py _on_upsert + client.add_template)
+    cluster, runner = booted
+    cluster.apply(template("K8SRequiredLabels", REQ_LABELS))
+    runner.watch_mgr.wait_idle()
+    # old-kind constraint no longer enforces
+    assert audit_results(runner).total_violations == 0
+    watched = set(runner.watch_mgr.watched_gvks())
+    assert constraint_gvk("K8sRequiredLabels") not in watched
+    assert constraint_gvk("K8SRequiredLabels") in watched
+    # controller-side state for the retired kind is dropped: no pod-status
+    # CR claims the old constraint is still enforced, and the constraints
+    # gauge no longer counts it
+    from gatekeeper_tpu.control.status import CONSTRAINT_STATUS_GVK
+
+    uids = {
+        (o.get("status") or {}).get("constraintUID")
+        for o in cluster.list(CONSTRAINT_STATUS_GVK)
+    }
+    assert "K8sRequiredLabels/need-owner" not in uids
+    gauges = {
+        k: v
+        for k, v in runner.metrics.snapshot()["gauges"].items()
+        if k.startswith("constraints{")
+    }
+    assert gauges and all(v == 0 for v in gauges.values()), gauges
+    # a new-kind constraint flows through the fresh watch
+    cluster.apply(
+        constraint(
+            "K8SRequiredLabels", "need-owner", params={"labels": ["owner"]}
+        )
+    )
+    runner.watch_mgr.wait_idle()
+    assert audit_results(runner).total_violations == 1
+
+
 def test_constraint_churn(booted):
     cluster, runner = booted
     cluster.apply(

@@ -433,3 +433,16 @@ def test_batch_failure_falls_back_per_request(client):
     for results in outs:
         # the CPU fallback still produced the correct deny results
         assert any(r.enforcement_action == "deny" for r in results)
+
+
+def test_batcher_submit_after_stop_dispatches_inline(client):
+    """submit() racing stop() must not strand the caller's future until
+    the request timeout: once the worker is gone, dispatch inline."""
+    from gatekeeper_tpu.webhook.server import MicroBatcher
+
+    batcher = MicroBatcher(client, TARGET, window_ms=1.0)
+    batcher.start()
+    batcher.stop()
+    fut = batcher.submit(admission_request(pod("late", labels={})))
+    results = fut.result(timeout=5)
+    assert any(r.enforcement_action == "deny" for r in results)
