@@ -194,6 +194,7 @@ class TpuDriver(RegoDriver):
         # instrumentation for tests/bench: compiled-path pair evaluations
         # vs interpreter fallback evaluations in the last query
         self.stats: Dict[str, int] = {}
+        self._render_errors = 0  # compiled-render bugs degraded to interp
 
     # -- module/data bookkeeping (cache invalidation) ------------------------
 
@@ -792,21 +793,40 @@ class TpuDriver(RegoDriver):
                     cached = (gens, {})
                     self._render_cache[target] = cached
                 render_cache = cached[1]
+            # compiled-render pre-pass (VERDICT r3 #1): exact programs'
+            # violating pairs render from their branch plans via one
+            # numpy evaluation over the violating rows — no interpreter.
+            # Pairs the plans cannot prove exact fall through below.
+            uncached = [
+                p
+                for p in pairs
+                if render_cache is None or p not in render_cache
+            ]
+            host_rendered = self._host_render_pairs(
+                cs, corpus, uncached, reviews
+            )
             per_review: List[List[Result]] = [[] for _ in reviews]
             n_results = 0
+            n_host = 0
+            n_interp_render = 0
             frozen: Dict[int, Any] = {}  # review idx -> frozen review
             for n_i, c_i in pairs:
                 out = None
                 if render_cache is not None:
                     out = render_cache.get((n_i, c_i))
                 if out is None:
-                    fr = frozen.get(n_i)
-                    if fr is None:
-                        fr = frozen[n_i] = freeze(reviews[n_i])
-                    out = self._eval_template(
-                        target, cs.constraints[c_i], reviews[n_i],
-                        inventory, trace, frozen_review=fr
-                    )
+                    out = host_rendered.get((n_i, c_i))
+                    if out is not None:
+                        n_host += 1
+                    else:
+                        fr = frozen.get(n_i)
+                        if fr is None:
+                            fr = frozen[n_i] = freeze(reviews[n_i])
+                        out = self._eval_template(
+                            target, cs.constraints[c_i], reviews[n_i],
+                            inventory, trace, frozen_review=fr
+                        )
+                        n_interp_render += 1
                     if render_cache is not None:
                         render_cache[(n_i, c_i)] = out
                 per_review[n_i].extend(out)
@@ -817,6 +837,9 @@ class TpuDriver(RegoDriver):
                 "n_reviews": n_count,
                 "n_constraints": c_count,
                 "n_results": n_results,
+                "host_rendered_pairs": n_host,
+                "interp_rendered_pairs": n_interp_render,
+                "render_errors": self._render_errors,
             }
             if trace is not None:
                 trace.append(
@@ -824,6 +847,104 @@ class TpuDriver(RegoDriver):
                     f"pairs, {self.stats['interp_pairs']} interpreter pairs"
                 )
             return per_review
+
+    # -- compiled message rendering ------------------------------------------
+
+    def _host_render_pairs(
+        self,
+        cs: _ConstraintSet,
+        corpus: _Corpus,
+        pairs: List[Tuple[int, int]],
+        reviews: List[Any],
+    ) -> Dict[Tuple[int, int], List[Result]]:
+        """Render violating pairs of exact programs from their compiled
+        branch plans (engine/render.py): one numpy branch evaluation per
+        (program, violating-row-subset), then per-row message decoding
+        from the token table + raw review — the interpreter never runs.
+        Rows/pairs the plans cannot prove exact are omitted (the caller
+        falls back per pair). Violation objects render once per
+        (program, row) and fan out to every constraint sharing the
+        program (identical params => identical violations; only the
+        constraint/enforcement fields differ)."""
+        out: Dict[Tuple[int, int], List[Result]] = {}
+        by_prog: Dict[int, Tuple[Program, List[Tuple[int, int]]]] = {}
+        for n_i, c_i in pairs:
+            p = cs.programs[c_i]
+            if p is None or not p.branches:
+                continue
+            if corpus.row_fallback[n_i]:
+                continue  # overflow rows: interpreter territory
+            ent = by_prog.get(id(p))
+            if ent is None:
+                ent = by_prog[id(p)] = (p, [])
+            ent[1].append((n_i, c_i))
+        if not by_prog:
+            return out
+        from ..engine.exprs import EvalCtx
+        from ..engine.render import RenderSet
+
+        member = np.asarray(self.patterns.member)
+        capture = np.asarray(self.patterns.capture)
+        tabs = {k: np.asarray(v) for k, v in self.tables.arrays().items()}
+        for prog, plist in by_prog.values():
+            rows = sorted({n for n, _ in plist})
+            pos = {n: i for i, n in enumerate(rows)}
+            idx = np.asarray(rows, np.int64)
+            tok_slice = {k: v[idx] for k, v in corpus.tok.items()}
+            ctx = EvalCtx(
+                np=np,
+                tok=tok_slice,
+                pat_member=member,
+                pat_capture=capture,
+                str_tables=tabs,
+                consts=prog.consts,
+                g0=corpus.g,
+                g1=corpus.g,
+            )
+            try:
+                rset = RenderSet(prog, ctx, self.vocab)
+                row_objs = {
+                    n: rset.render_row(pos[n], reviews[n]) for n in rows
+                }
+            except Exception:
+                # a plan evaluation bug must degrade to the interpreter,
+                # never fail the sweep; surfaced via stats for tests
+                self._render_errors += 1
+                continue
+            for n_i, c_i in plist:
+                objs = row_objs.get(n_i)
+                if objs is None:
+                    continue
+                out[(n_i, c_i)] = _results_from_objs(
+                    objs, cs.constraints[c_i], reviews[n_i]
+                )
+        return out
+
+
+def _results_from_objs(
+    objs: List[Any], constraint: Dict[str, Any], review: Any
+) -> List[Result]:
+    """Frozen violation objects -> Result list, mirroring the hook's
+    shape exactly (RegoDriver._eval_template): msg-less violations drop,
+    details default {} (client/regolib/src.go:23-42)."""
+    from ..rego.values import thaw
+
+    enforcement = M.enforcement_action(constraint)
+    out: List[Result] = []
+    for v in objs:
+        tv = thaw(v)
+        if not isinstance(tv, dict) or "msg" not in tv:
+            continue
+        out.append(
+            Result(
+                msg=tv["msg"],
+                metadata={"details": M.hook_get_default(tv, "details", {})},
+                constraint=constraint,
+                review=review,
+                enforcement_action=enforcement,
+            )
+        )
+    return out
 
 
 def _features_np(fb) -> Dict[str, np.ndarray]:

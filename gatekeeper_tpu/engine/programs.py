@@ -36,6 +36,11 @@ class Program:
     # per-row feature names this program consumes ("invdup:<pattern>"
     # join-key duplication bits the dispatch layer computes per corpus)
     row_features: Tuple[str, ...] = ()
+    # compiled-render metadata (exact programs only, engine/render.py):
+    # grouped violation branches (un-flagged cond + head render plan) and
+    # row-level safety flags; flagged rows render via the interpreter
+    branches: Optional[Tuple] = None
+    flags: Tuple = ()
 
 
 def compile_program(
@@ -54,6 +59,7 @@ def compile_program(
         comp = Compiler(env, modules, params, screen_mode=True)
         expr = comp.compile_violation_counts()
         comp.uses_inventory = True
+        comp.opaque = True  # retried programs' conditions over-approximate
     env.patterns.sync()
     env.tables.sync()
     sig = tuple(
@@ -67,6 +73,11 @@ def compile_program(
         signature=sig,
         screen=comp.uses_inventory,
         row_features=tuple(comp.row_features),
+        # render branches stay valid when only safety FLAGS fired (the
+        # render path routes flagged rows to the interpreter itself);
+        # genuine opacity (dropped conditions) disables them entirely
+        branches=tuple(comp.out_branches) if not comp.opaque else None,
+        flags=tuple(comp.out_flags),
     )
 
 

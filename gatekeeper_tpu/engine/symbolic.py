@@ -333,6 +333,9 @@ class SMsg(SVal):
     # exploded: several message-position sprintf tables mutually
     # transforming each other's products grow the vocab exponentially.
     recipe: Optional[Tuple[str, Any]] = None
+    # render recipe for the compiled message path (engine/render.py):
+    # ("sprintf", fmt, (SVal, ...)) or ("obj", ((const_key, SVal), ...))
+    parts: Any = None
 
     def signature(self):
         return self.sig if self.sig is not None else ("opaque", id(self))
@@ -374,6 +377,11 @@ class SDerived(SVal):
 
     num: Expr
     defined: Expr
+    # render recipe when the derived number stands in for a computable
+    # value, e.g. ("constdiff", elems, STokenSet) for const-set minus
+    # token-set (the `missing` idiom) — engine/render.py rebuilds the
+    # actual set host-side from it
+    render: Any = None
 
 
 @dataclass
@@ -529,6 +537,12 @@ class Compiler:
         self.signature: List[Any] = []  # structural program signature
         self.uses_inventory = False  # compiled as a screen (see
         # InventoryDependent): flagged pairs re-check via interpreter
+        # opaque: some CONDITION was dropped (inventory content or an
+        # uncompilable call/comprehension became an opaque value), so
+        # branch conds over-approximate for EVERY row and the compiled
+        # render is off. Safety FLAGS alone do not set this — a flagged
+        # program is exact on unflagged rows (the flag routes the rest).
+        self.opaque = False
         self._no_inv_catch = 0  # >0 inside negation bodies
         # row-level safety flags: [N]-space bools OR'd into the clause
         # being compiled when a construct is handled under a shape
@@ -545,6 +559,12 @@ class Compiler:
         self._clause_guards: List[Tuple[int, Tuple[int, ...]]] = []
         self._inv_root_n = 0  # fresh ids for inventory iterations
         self.row_features: List[str] = []  # features programs consume
+        # outputs of compile_violation_counts for the compiled-render
+        # path (engine/render.py): grouped violation branches with their
+        # un-flagged conditions + render plans, and the program's safety
+        # flags (a flagged row renders via the interpreter)
+        self.out_branches: List[Any] = []
+        self.out_flags: List[Expr] = []
 
     def _pattern(self, segs: Tuple[str, ...]) -> int:
         idx = self.patterns.register(segs)
@@ -557,7 +577,8 @@ class Compiler:
         clauses = self.rules.get("violation")
         if not clauses:
             raise CompileUnsupported("no violation rule")
-        branches: List[Tuple[Any, Tuple[str, ...], Expr]] = []
+        branches: List[Tuple[Any, Tuple[str, ...], Expr, Optional[Expr], Any]]
+        branches = []
         for rule in clauses:
             if rule.is_default or rule.else_rule is not None:
                 raise CompileUnsupported("default/else violation rule")
@@ -569,18 +590,35 @@ class Compiler:
         # (e.g. containerlimits' two "has no resource limits" clauses).
         # Branches with EQUAL head signatures on the same space are OR'd;
         # everything else sums.
-        grouped: Dict[Any, Expr] = {}
+        grouped: Dict[Any, List[Any]] = {}
         order: List[Any] = []
-        for sig, space, cond in branches:
+        for sig, space, cond, cond_exact, plan in branches:
             key = (sig, space)
-            if key in grouped:
-                grouped[key] = e_or(grouped[key], cond)
+            ent = grouped.get(key)
+            if ent is not None:
+                ent[0] = e_or(ent[0], cond)
+                # equal sigs render identically (the dedup contract the
+                # count layer already relies on): OR the exact conds and
+                # keep the first available plan
+                if ent[1] is None or cond_exact is None:
+                    ent[1] = None
+                else:
+                    ent[1] = e_or(ent[1], cond_exact)
+                if ent[2] is None:
+                    ent[2] = plan
             else:
-                grouped[key] = cond
+                grouped[key] = [cond, cond_exact, plan]
                 order.append(key)
+        from .render import Branch
+
+        self.out_branches = []
         counts: List[Expr] = []
         for key in order:
-            cond = grouped[key]
+            cond, cond_exact, plan = grouped[key]
+            if cond_exact is not None:
+                self.out_branches.append(
+                    Branch(space=key[1], cond=cond_exact, plan=plan)
+                )
             cnt = EMap(lambda np_, c: c.astype(np.int32), [cond], "toint")
             while cnt.space:
                 cnt = EReduceAxis(cnt, cnt.space[-1], "sum")
@@ -592,7 +630,7 @@ class Compiler:
 
     def _compile_clause(
         self, rule: A.Rule
-    ) -> List[Tuple[Any, Tuple[str, ...], Expr]]:
+    ) -> List[Tuple[Any, Tuple[str, ...], Expr, Optional[Expr], Any]]:
         flags_base = len(self._force_flags)
         joins_base = len(self._clause_joins)
         guards_base = len(self._clause_guards)
@@ -633,7 +671,8 @@ class Compiler:
                 join_refine = f if join_refine is None else e_and(
                     join_refine, f
                 )
-        outs: List[Tuple[Any, Tuple[str, ...], Expr]] = []
+        self.out_flags.extend(clause_flags)
+        outs: List[Tuple[Any, Tuple[str, ...], Expr, Optional[Expr], Any]] = []
         for st in finals:
             # the head must evaluate too (undefined heads drop violations);
             # its render-signature drives cross-clause set dedup
@@ -646,24 +685,39 @@ class Compiler:
                 cond = self._conj(st)
                 if join_refine is not None:
                     cond = e_and(cond, join_refine)
+                exact = cond
                 cond = self._with_flags(cond, clause_flags)
                 outs.append(
-                    (("inv-head", id(rule), len(outs)), cond.space, cond)
+                    (
+                        ("inv-head", id(rule), len(outs)),
+                        cond.space,
+                        cond,
+                        exact,
+                        None,
+                    )
                 )
                 continue
             for hv, hs in head_forks:
                 cond = self._conj(hs)
                 if join_refine is not None:
                     cond = e_and(cond, join_refine)
+                exact = cond
                 cond = self._with_flags(cond, clause_flags)
-                outs.append((_freeze_sig(_val_sig(hv)), cond.space, cond))
+                plan = None
+                if not self.screen_mode:
+                    from .render import build_plan
+
+                    plan = build_plan(self, hv)
+                outs.append(
+                    (_freeze_sig(_val_sig(hv)), cond.space, cond, exact, plan)
+                )
         if not outs and clause_flags:
             # the clause compiled to statically-nothing but carries
             # safety flags: flagged rows must still route
             flag = clause_flags[0]
             for f in clause_flags[1:]:
                 flag = e_or(flag, f)
-            outs.append((("flag-only", id(rule)), flag.space, flag))
+            outs.append((("flag-only", id(rule)), flag.space, flag, None, None))
         return outs
 
     def _with_flags(self, cond: Expr, flags: List[Expr]) -> Expr:
@@ -955,6 +1009,7 @@ class Compiler:
                     return self._eval_comprehension(term, st)
                 except (CompileUnsupported, InventoryDependent):
                     self.uses_inventory = True
+                    self.opaque = True
                     return [(SInventory(), st)]
             return self._eval_comprehension(term, st)
         if isinstance(term, A.ArrayTerm):
@@ -1013,13 +1068,21 @@ class Compiler:
                 symbolic = True
         if symbolic:
             sig_items = []
+            part_items: Optional[List[Tuple[Any, Any]]] = []
             for k, v in term.items:
                 kf = self._eval_term(k, st)
                 kv = kf[0][0] if kf else None
                 vf = self._eval_term(v, st)
                 vv = vf[0][0] if vf else None
                 sig_items.append((_val_sig(kv), _val_sig(vv)))
-            return [(SMsg(sig=("obj", tuple(sig_items))), cur)]
+                if part_items is not None and isinstance(kv, SConst):
+                    part_items.append((kv.value, vv))
+                else:
+                    part_items = None  # symbolic key: no render recipe
+            parts = (
+                ("obj", tuple(part_items)) if part_items is not None else None
+            )
+            return [(SMsg(sig=("obj", tuple(sig_items)), parts=parts), cur)]
         return [(SConst(concrete), cur)]
 
     # -- refs ---------------------------------------------------------------
@@ -1051,6 +1114,7 @@ class Compiler:
                 # and conditions on it drop (InventoryDependent); walking
                 # with unbound vars binds them opaquely too
                 self.uses_inventory = True
+                self.opaque = True
                 self._inv_root_n += 1
                 return self._walk(
                     SInventory(path=(), root=self._inv_root_n),
@@ -1516,6 +1580,7 @@ class Compiler:
                 # _inv_barrier) means the call's value depends on
                 # inventory content: opaque, conditions on it drop
                 self.uses_inventory = True
+                self.opaque = True
                 return [(SInventory(), st)]
         return self._apply_call_inner(name, args, st)
 
@@ -2014,7 +2079,14 @@ class Compiler:
             cnt = terms[0]
             for t in terms[1:]:
                 cnt = e_arith("+", cnt, t)
-            return (SDerived(num=cnt, defined=ELit(True)), st)
+            return (
+                SDerived(
+                    num=cnt,
+                    defined=ELit(True),
+                    render=("constdiff", tuple(elems), rv),
+                ),
+                st,
+            )
         if isinstance(lv, STokenSet) and isinstance(rv, SConst):
             if not isinstance(rv.value, (set, frozenset)):
                 return None
@@ -2604,8 +2676,25 @@ class Compiler:
                     )
                 ):
                     # lazily materializable (see SMsg.recipe)
-                    return [(SMsg(sig=sig, recipe=(fmt.value, arg0)), st)]
-                return [(SMsg(sig=sig), st)]
+                    return [
+                        (
+                            SMsg(
+                                sig=sig,
+                                recipe=(fmt.value, arg0),
+                                parts=("sprintf", fmt.value, tuple(items)),
+                            ),
+                            st,
+                        )
+                    ]
+                return [
+                    (
+                        SMsg(
+                            sig=sig,
+                            parts=("sprintf", fmt.value, tuple(items)),
+                        ),
+                        st,
+                    )
+                ]
         return [(SMsg(), st)]
 
     def _builtin_concat(self, args, st):

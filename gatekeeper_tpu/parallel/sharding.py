@@ -57,6 +57,29 @@ def audit_mesh(
     return Mesh(arr, ("c", "n"))
 
 
+def _get_overlapped(out):
+    """Fetch a pytree of device arrays in ONE round trip: start every
+    device->host copy async, then materialize. jax.device_get alone
+    copies leaf-by-leaf, paying the tunnel RTT once per leaf (~150ms x
+    5 outputs per dispatch dominated the webhook batch path)."""
+    for x in jax.tree_util.tree_leaves(out):
+        try:
+            x.copy_to_host_async()
+        except Exception:
+            pass
+    return jax.device_get(out)
+
+
+def _pad_len(n: int) -> int:
+    """Padded vocab-axis capacity: next power of two with headroom for
+    at least one full delta chunk, so growth stays in-bucket for a
+    while and jit shapes stay stable."""
+    p = 4096
+    while p < n + FusedAuditKernel._DELTA_ROWS:
+        p *= 2
+    return p
+
+
 def _pad_axis(a: np.ndarray, axis: int, mult: int, fill) -> np.ndarray:
     n = a.shape[axis]
     target = ((n + mult - 1) // mult) * mult
@@ -160,8 +183,21 @@ class FusedAuditKernel:
         self._jit_cache: Dict[Tuple, List[Any]] = {}
         self._table_cache: Optional[Tuple[Tuple[int, int], Dict[str, Any]]] = None
         self._fused_cols: Dict[str, Dict[Any, int]] = {}
+        # delta-resident table buffers: name -> [device buf (vocab axis
+        # padded), filled rows, non-vocab dims]. Steady-state vocab
+        # growth (every admission batch interns new object names) ships
+        # only the NEW rows to the device and leaves jit shapes stable —
+        # without this, each webhook batch re-uploaded every table
+        # (~1s on a tunneled chip) and recompiled on the changed shapes
+        self._resident: Dict[str, Tuple[Any, int, Tuple]] = {}
 
     # -- shardings -----------------------------------------------------------
+
+    # delta-upload granularity for vocab-axis table growth: deltas pad
+    # to multiples of this, so at most a handful of distinct jit shapes.
+    # Small on purpose: the tunnel h2d path moves ~5-8MB/s, and a
+    # webhook batch interns only a few hundred new vocab entries
+    _DELTA_ROWS = 512
 
     def _spec(self, *axes) -> Optional[NamedSharding]:
         if self.mesh is None:
@@ -179,11 +215,14 @@ class FusedAuditKernel:
         gen = (self.patterns.generation, self.tables.generation)
         if self._table_cache is None or self._table_cache[0] != gen:
             str_arrs = self.tables.arrays()
-            arrs = {
-                "pat_member": self.patterns.member,
-                "pat_capture": self.patterns.capture,
-                **str_arrs,
+            # (host array, vocab axis): the vocab axis is padded to a
+            # stable bucket and extended by delta uploads
+            host: Dict[str, Tuple[np.ndarray, int]] = {
+                "pat_member": (np.asarray(self.patterns.member), 1),
+                "pat_capture": (np.asarray(self.patterns.capture), 1),
             }
+            for name, tab in str_arrs.items():
+                host[name] = (np.asarray(tab), 0)
             # fused transposed copies: a TPU gather op costs ~10ms
             # regardless of width, so the sweep gathers every column in
             # a handful of [V, T] row-gathers instead of one op per
@@ -192,12 +231,12 @@ class FusedAuditKernel:
             fused_cols: Dict[str, Dict[Any, int]] = {}
             pm = np.asarray(self.patterns.member)
             if pm.size:
-                arrs["pat_member!T"] = np.ascontiguousarray(pm.T)
+                host["pat_member!T"] = (np.ascontiguousarray(pm.T), 0)
                 fused_cols["pat_member"] = {
                     i: i for i in range(pm.shape[0])
                 }
                 pc = np.asarray(self.patterns.capture)
-                arrs["pat_capture!T"] = np.ascontiguousarray(pc.T)
+                host["pat_capture!T"] = (np.ascontiguousarray(pc.T), 0)
                 fused_cols["pat_capture"] = {
                     i: i for i in range(pc.shape[0])
                 }
@@ -213,17 +252,115 @@ class FusedAuditKernel:
             for kind, items in by_kind.items():
                 dt = {"vid_bool": np.bool_, "vid_i32": np.int32,
                       "vid_f32": np.float32}[kind]
-                arrs[kind + "!T"] = np.ascontiguousarray(
-                    np.stack([t for _, t in items], axis=1).astype(dt)
+                host[kind + "!T"] = (
+                    np.ascontiguousarray(
+                        np.stack([t for _, t in items], axis=1).astype(dt)
+                    ),
+                    0,
                 )
                 fused_cols[kind] = {
                     name: i for i, (name, _) in enumerate(items)
                 }
-            # replicated policy-side tensors
-            arrs = {k: self._put(v) for k, v in arrs.items()}
+            pending: Dict[str, Tuple[Any, np.ndarray, int, int]] = {}
+            arrs = {
+                k: self._stage_table(k, a, ax, pending)
+                for k, (a, ax) in host.items()
+            }
+            if pending:
+                # apply EVERY table's delta in ONE jitted call — one
+                # device dispatch per batch instead of one per table
+                # (each dispatch pays tunnel overhead)
+                for name, buf in self._flush_deltas(pending).items():
+                    vlen, other = arrs[name]
+                    arrs[name] = buf
+                    self._resident[name] = (buf, vlen, other)
+            for stale in set(self._resident) - set(host):
+                del self._resident[stale]
             self._fused_cols = fused_cols
             self._table_cache = (gen, arrs)
         return self._table_cache[1]
+
+    def _stage_table(self, name: str, a: np.ndarray, ax: int, pending):
+        """Device-resident table with vocab-axis padding: vocab growth
+        within the padded bucket ships only the new rows (queued into
+        `pending` for one fused fixed-shape dynamic_update_slice — no
+        recompiles, no full re-upload); structural changes (new
+        patterns/tables, bucket overflow) fall back to a full padded
+        upload. Returns the device buffer, or (vlen, other) when the
+        result comes from the pending flush."""
+        vlen = a.shape[ax]
+        other = a.shape[:ax] + a.shape[ax + 1:]
+        ent = self._resident.get(name)
+        if ent is not None:
+            buf, fill, other0 = ent
+            cap = buf.shape[ax]
+            if (
+                other0 == other
+                and str(buf.dtype) == str(a.dtype)
+                and fill <= vlen
+            ):
+                if fill == vlen:
+                    return buf
+                dl = vlen - fill
+                dpad = -(-dl // self._DELTA_ROWS) * self._DELTA_ROWS
+                if fill + dpad <= cap:
+                    sl = [slice(None)] * a.ndim
+                    sl[ax] = slice(fill, vlen)
+                    delta = a[tuple(sl)]
+                    if dpad != dl:
+                        pad_shape = list(delta.shape)
+                        pad_shape[ax] = dpad - dl
+                        delta = np.concatenate(
+                            [delta, np.zeros(pad_shape, a.dtype)], axis=ax
+                        )
+                    pending[name] = (buf, delta, fill, ax)
+                    return (vlen, other)
+        cap = _pad_len(vlen)
+        pad_shape = list(a.shape)
+        pad_shape[ax] = cap - vlen
+        padded = np.concatenate(
+            [a, np.zeros(pad_shape, a.dtype)], axis=ax
+        ) if cap != vlen else a
+        buf = self._put(padded)
+        self._resident[name] = (buf, vlen, other)
+        return buf
+
+    def _flush_deltas(self, pending) -> Dict[str, Any]:
+        names = sorted(pending)
+        key = (
+            "tabdelta",
+            tuple(
+                (
+                    n,
+                    pending[n][0].shape,
+                    str(pending[n][0].dtype),
+                    pending[n][1].shape,
+                    pending[n][3],
+                )
+                for n in names
+            ),
+        )
+        ent = self._jit_cache.get(key)
+        if ent is None:
+            axes = {n: pending[n][3] for n in names}
+
+            def upd(bufs, deltas, offs):
+                out = {}
+                for n in names:
+                    b = bufs[n]
+                    starts = [jnp.int32(0)] * b.ndim
+                    starts[axes[n]] = offs[n]
+                    out[n] = jax.lax.dynamic_update_slice(
+                        b, deltas[n].astype(b.dtype), tuple(starts)
+                    )
+                return out
+
+            ent = self._jit_cache[key] = [upd, jax.jit(upd)]
+        return ent[1](
+            {n: pending[n][0] for n in names},
+            {n: jnp.asarray(pending[n][1]) for n in names},
+            {n: jnp.int32(pending[n][2]) for n in names},
+        )
 
     # -- staged sparse dispatch ---------------------------------------------
 
@@ -421,8 +558,27 @@ class FusedAuditKernel:
                         consts_in, compiled_mask, rf_c, nv_c, row_c,
                     )
 
-                return jax.lax.map(
+                packed, hot, n_hot, sc, si = jax.lax.map(
                     body, (fb_in, tok_in, row_fb, n_valid, row_in)
+                )
+                # fuse the five outputs into ONE int32 buffer: a
+                # device->host fetch pays the tunnel RTT per ARRAY (the
+                # copies do not overlap), so five leaves cost five RTTs
+                k_chunks, p8 = packed.shape
+                pad = (-p8) % 4
+                pw = jnp.pad(packed, ((0, 0), (0, pad))).reshape(
+                    k_chunks, (p8 + pad) // 4, 4
+                )
+                pwords = jax.lax.bitcast_convert_type(pw, jnp.int32)
+                return jnp.concatenate(
+                    [
+                        pwords,
+                        hot,
+                        n_hot[:, None],
+                        sc[:, None],
+                        si[:, None],
+                    ],
+                    axis=1,
                 )
 
             entry = [run_all, jax.jit(run_all)]
@@ -440,7 +596,21 @@ class FusedAuditKernel:
             corpus.n_valid,
             row_dev,
         )
-        return jax.device_get(out)  # one transfer for the whole sweep
+        buf = np.asarray(out)  # ONE transfer for the whole sweep
+        # unpack (see run_all): [pwords | hot | n_hot | sc | si]
+        r_eff = min(r_cap, corpus.chunk)
+        p8 = -(-policy.c_pad * r_eff // 8)
+        w4 = -(-p8 // 4)
+        packed = (
+            np.ascontiguousarray(buf[:, :w4])
+            .view(np.uint8)
+            .reshape(corpus.k, -1)[:, :p8]
+        )
+        hot = buf[:, w4:w4 + r_eff]
+        n_hot = buf[:, w4 + r_eff]
+        sc = buf[:, w4 + r_eff + 1]
+        si = buf[:, w4 + r_eff + 2]
+        return packed, hot, n_hot, sc, si
 
     def _need_chunk_fn(self, policy: StagedPolicy, g: int, r_cap: int):
         """The shared per-chunk need computation (trace-time closure
@@ -589,7 +759,7 @@ class FusedAuditKernel:
         )
         if not block:
             return out
-        packed, hot, n_hot, stat_c, stat_i = jax.device_get(out)
+        packed, hot, n_hot, stat_c, stat_i = _get_overlapped(out)
         return packed, hot, int(n_hot), int(stat_c), int(stat_i)
 
     # -- dispatch ------------------------------------------------------------

@@ -243,6 +243,51 @@ def test_compiled_path_dominates(clients):
     assert drv.stats["interp_pairs"] == 0, drv.stats
 
 
+def test_compiled_render_covers_library_mix(clients):
+    """VERDICT r3 #1: violating pairs of exact programs render from
+    compiled branch plans (engine/render.py), not the interpreter —
+    and stay bit-exact (order included, via test_audit_order_identical).
+    The library mix above is all-exact, so every violating pair must
+    host-render with zero degraded plan evaluations."""
+    _, tpu, drv = clients
+    tpu.audit()
+    assert drv.stats["host_rendered_pairs"] > 0, drv.stats
+    assert drv.stats["interp_rendered_pairs"] == 0, drv.stats
+    assert drv.stats["render_errors"] == 0, drv.stats
+
+
+def test_compiled_render_batched_review_parity(clients):
+    """The webhook micro-batch path (query_many) renders violating
+    reviews from plans too: 100%-violating batch, exact order parity
+    per review vs the interpreter driver."""
+    rego, tpu, drv = clients
+    batch = [
+        AugmentedUnstructured(
+            pod(
+                f"viol{i}",
+                containers=[
+                    {
+                        "name": "c",
+                        "image": "docker.io/evil",
+                        "securityContext": {"privileged": True},
+                    }
+                ],
+                spec_extra={"hostIPC": True},
+            )
+        )
+        for i in range(16)
+    ]
+    got = tpu.review_many(batch)
+    assert drv.stats["host_rendered_pairs"] > 0, drv.stats
+    assert drv.stats["interp_rendered_pairs"] == 0, drv.stats
+    assert drv.stats["render_errors"] == 0, drv.stats
+    for i, (g, b) in enumerate(zip(got, batch)):
+        want = rego.review(b).by_target[TARGET].results
+        assert [result_key(r) for r in g.by_target[TARGET].results] == [
+            result_key(r) for r in want
+        ], f"mismatch on batch review {i}"
+
+
 def test_audit_cache_reused(clients):
     """Steady-state sweeps reuse the encoded corpus (no re-encode)."""
     _, tpu, drv = clients
