@@ -138,6 +138,13 @@ class _Corpus:
     row_feats: Optional[Dict[str, np.ndarray]] = None
     # per-pattern join-key value counts (pid -> (counts, has_fallback))
     value_counts: Optional[Dict[int, Any]] = None
+    # ephemeral vocab overlay (webhook batches): the batch's novel
+    # strings + their pattern/table rows, never interned globally
+    vocab: Any = None  # OverlayVocab for ephemeral corpora, else None
+    v_base: int = 0
+    ov_member: Optional[np.ndarray] = None  # [B_pad, P] bool
+    ov_capture: Optional[np.ndarray] = None  # [B_pad, P] int32
+    ov_tabs: Optional[Dict[str, np.ndarray]] = None  # name -> [B_pad]
 
 
 @dataclass
@@ -338,11 +345,18 @@ class TpuDriver(RegoDriver):
     # -- corpus encoding -----------------------------------------------------
 
     def _encode_reviews(
-        self, reviews: List[Any], ns_cache: Dict[str, Any]
+        self,
+        reviews: List[Any],
+        ns_cache: Dict[str, Any],
+        vocab: Any = None,
     ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any], int, np.ndarray]:
-        table = encode_token_table(reviews, self.vocab)
+        """`vocab` overrides the intern target — ephemeral review batches
+        pass an OverlayVocab so batch churn never grows the base."""
+        if vocab is None:
+            vocab = self.vocab
+        table = encode_token_table(reviews, vocab)
         feats = [
-            encode_review_features(r, ns_cache, self.vocab) for r in reviews
+            encode_review_features(r, ns_cache, vocab) for r in reviews
         ]
         fb = batch_review_features(feats)
         tok = {
@@ -387,8 +401,83 @@ class TpuDriver(RegoDriver):
             g=g,
             row_fallback=row_fallback,
         )
+        # classify the freshly interned path entries NOW: callers probe
+        # membership (_pattern_tokens) straight after building the corpus
+        self.patterns.sync()
+        self.tables.sync()
         self._corpus[target] = corpus
         return corpus
+
+    def _ephemeral_corpus(
+        self,
+        target: str,
+        cs: _ConstraintSet,
+        reviews: List[Any],
+        ns_cache: Dict[str, Any],
+    ) -> _Corpus:
+        """Encode a review batch against an OverlayVocab and build its
+        pattern/table overlay blocks. The base vocab, patterns, and
+        tables never change, so steady-state admission pays no global
+        table growth, no device re-uploads, and no jit churn — the
+        batch ships its own few-hundred-row overlay instead."""
+        from ..flatten.vocab import OverlayVocab
+
+        # base must be at its fixed point BEFORE the overlay snapshot,
+        # or overlay ids alias base ids assigned later in this call.
+        # Inventory-screen row features encode the persistent audit
+        # corpus mid-evaluation — pre-encode it now if any program will
+        # need it (cached per data generation, so this is one-time).
+        if any(p is not None and p.row_features for p in cs.programs):
+            self._audit_corpus(target)
+        self.patterns.sync()
+        self.tables.sync()
+        overlay = OverlayVocab(self.vocab)
+        tok, fb_dev, g, row_fallback = self._encode_reviews(
+            reviews, ns_cache, vocab=overlay
+        )
+        v_base = overlay.base_len
+        # fill table rows + pattern rows for overlay entries to a fixed
+        # point (transforms and captured segments intern new overlay
+        # strings as they go)
+        tab_parts: List[Dict[str, np.ndarray]] = []
+        mem_parts: List[np.ndarray] = []
+        cap_parts: List[np.ndarray] = []
+        cur = v_base
+        while cur < len(overlay):
+            end = len(overlay)
+            tab_parts.append(self.tables.fill_overlay(overlay, cur, end))
+            m, c = self.patterns.classify_overlay(overlay, cur, end)
+            mem_parts.append(m)
+            cap_parts.append(c)
+            cur = end
+        b = len(overlay) - v_base
+        b_pad = _bucket(max(b, 1), lo=128)
+        p = self.patterns.n_patterns
+        ov_member = np.zeros((b_pad, p), bool)
+        ov_capture = np.full((b_pad, p), -1, np.int32)
+        if b:
+            ov_member[:b] = np.concatenate(mem_parts, axis=0)
+            ov_capture[:b] = np.concatenate(cap_parts, axis=0)
+        ov_tabs: Dict[str, np.ndarray] = {}
+        if tab_parts and tab_parts[0]:
+            for name in tab_parts[0]:
+                col = np.concatenate([t[name] for t in tab_parts])
+                padded = np.zeros((b_pad,), col.dtype)
+                padded[:b] = col
+                ov_tabs[name] = padded
+        return _Corpus(
+            data_gen=-1,
+            reviews=reviews,
+            tok=tok,
+            fb_dev=fb_dev,
+            g=g,
+            row_fallback=row_fallback,
+            vocab=overlay,
+            v_base=v_base,
+            ov_member=ov_member,
+            ov_capture=ov_capture,
+            ov_tabs=ov_tabs,
+        )
 
     # -- device dispatch -----------------------------------------------------
 
@@ -417,7 +506,16 @@ class TpuDriver(RegoDriver):
             chunks.append(
                 (fb_c, tok_c, corpus.row_fallback[start:end], end - start)
             )
-        corpus.staged = self.kernel.stage_corpus_stacked(chunks)
+        ov = None
+        if corpus.ov_member is not None:
+            ov = {
+                "member": corpus.ov_member,
+                "capture": corpus.ov_capture,
+                "tabs": corpus.ov_tabs,
+            }
+        corpus.staged = self.kernel.stage_corpus_stacked(
+            chunks, ov=ov, v_base=corpus.v_base
+        )
         return corpus.staged
 
     def _need_pairs(
@@ -493,6 +591,14 @@ class TpuDriver(RegoDriver):
         if corpus.row_feats is None:
             corpus.row_feats = {}
         out: Dict[str, np.ndarray] = {}
+        # alias guard: if the BASE vocab grew past an ephemeral corpus's
+        # overlay snapshot (a path _ephemeral_corpus's pre-encode did not
+        # anticipate), overlay ids numerically collide with the new base
+        # ids and every id comparison below is unsound — degrade to the
+        # coarse screen (route everything) instead of guessing
+        if corpus.vocab is not None and len(self.vocab) > corpus.v_base:
+            ones = np.ones(len(corpus.reviews), bool)
+            return {name: ones for name in names}
         for name in names:
             cached = corpus.row_feats.get(name)
             if cached is not None:
@@ -553,6 +659,14 @@ class TpuDriver(RegoDriver):
         width = member.shape[1]
         safe = np.minimum(np.maximum(spath, 0), max(width - 1, 0))
         sel = (spath >= 0) & (spath < width) & member[pid][safe]
+        if corpus.ov_member is not None:
+            # ephemeral batches carry overlay path entries (novel label/
+            # annotation keys) whose membership lives in the batch blocks
+            loc = spath - corpus.v_base
+            b = corpus.ov_member.shape[0]
+            safe_loc = np.clip(loc, 0, max(b - 1, 0))
+            ov = (loc >= 0) & (loc < b) & corpus.ov_member[safe_loc, pid]
+            sel = np.where(loc >= 0, ov, sel)
         return sel, vids
 
     def _pattern_value_counts(self, corpus: _Corpus, pid: int):
@@ -602,7 +716,8 @@ class TpuDriver(RegoDriver):
         }
         while True:
             out = self.kernel.dispatch_need(
-                policy, batch, corpus.g, r_cap=r_cap, row_in=row_in
+                policy, batch, corpus.g, r_cap=r_cap, row_in=row_in,
+                ov_in=stacked.ov_dev, v_base=stacked.v_base,
             )
             if out[2] <= min(r_cap, stacked.chunk):
                 return out
@@ -621,8 +736,10 @@ class TpuDriver(RegoDriver):
         row_fb = np.asarray(corpus.row_fallback[:n], bool)
         viol = np.zeros((len(cs.constraints), n), bool)
         if compiled:
+            overlay = _corpus_overlay(corpus)
             counts = np.stack(
-                [self.evaluator.eval_np(p, corpus.tok, g=corpus.g)
+                [self.evaluator.eval_np(
+                    p, corpus.tok, g=corpus.g, overlay=overlay)
                  for p in compiled],
                 axis=0,
             )
@@ -759,16 +876,8 @@ class TpuDriver(RegoDriver):
             ns_cache = self._ns_cache(target)
             inventory = self._inventory(target)
             if corpus is None:
-                tok, fb_dev, g, row_fallback = self._encode_reviews(
-                    reviews, ns_cache
-                )
-                corpus = _Corpus(
-                    data_gen=-1,
-                    reviews=reviews,
-                    tok=tok,
-                    fb_dev=fb_dev,
-                    g=g,
-                    row_fallback=row_fallback,
+                corpus = self._ephemeral_corpus(
+                    target, cs, reviews, ns_cache
                 )
             self.patterns.sync()
             self.tables.sync()
@@ -886,6 +995,9 @@ class TpuDriver(RegoDriver):
         member = np.asarray(self.patterns.member)
         capture = np.asarray(self.patterns.capture)
         tabs = {k: np.asarray(v) for k, v in self.tables.arrays().items()}
+        overlay = _corpus_overlay(corpus)
+        ov = overlay or {}
+        corpus_vocab = corpus.vocab if corpus.vocab is not None else self.vocab
         for prog, plist in by_prog.values():
             rows = sorted({n for n, _ in plist})
             pos = {n: i for i, n in enumerate(rows)}
@@ -900,9 +1012,13 @@ class TpuDriver(RegoDriver):
                 consts=prog.consts,
                 g0=corpus.g,
                 g1=corpus.g,
+                v_base=ov.get("v_base"),
+                ov_member=ov.get("member"),
+                ov_capture=ov.get("capture"),
+                ov_tabs=ov.get("tabs"),
             )
             try:
-                rset = RenderSet(prog, ctx, self.vocab)
+                rset = RenderSet(prog, ctx, corpus_vocab)
                 row_objs = {
                     n: rset.render_row(pos[n], reviews[n]) for n in rows
                 }
@@ -919,6 +1035,18 @@ class TpuDriver(RegoDriver):
                     objs, cs.constraints[c_i], reviews[n_i]
                 )
         return out
+
+
+def _corpus_overlay(corpus: _Corpus) -> Optional[Dict[str, Any]]:
+    """Vocab-overlay ctx blocks for host/numpy evaluation paths."""
+    if corpus.ov_member is None:
+        return None
+    return {
+        "v_base": corpus.v_base,
+        "member": corpus.ov_member,
+        "capture": corpus.ov_capture,
+        "tabs": corpus.ov_tabs,
+    }
 
 
 def _results_from_objs(

@@ -63,6 +63,17 @@ class EvalCtx:
     # duplication bits; ERowFeature reads them, defaulting to True
     # (unrefined) when a caller supplies none
     row: Optional[Dict[str, Any]] = None
+    # ephemeral vocab overlay (flatten.vocab.OverlayVocab): ids >= v_base
+    # resolve against the batch's overlay blocks instead of the base
+    # tables. ov_member/ov_capture are [B, P] (entry-major); ov_tabs maps
+    # table name -> [B] rows (host/numpy path); ov_slabs/ov_cols carry
+    # the per-kind [B, T] stacks for the device path.
+    v_base: Optional[Any] = None
+    ov_member: Optional[Any] = None
+    ov_capture: Optional[Any] = None
+    ov_tabs: Optional[Dict[str, Any]] = None
+    ov_slabs: Optional[Dict[str, Any]] = None
+    ov_cols: Optional[Dict[str, Tuple[str, int]]] = None
 
     @property
     def n(self) -> int:
@@ -230,11 +241,24 @@ class ESelPattern(Expr):
     def _emit(self, ctx):
         spath = ctx.tok["spath"]
         if ctx.slabs is not None and "pat_member" in ctx.slabs:
+            # overlay resolution happened at slab pre-gather time
             col = ctx.slab_cols["pat_member"].get(self.pattern_idx)
             if col is not None:
                 return (spath >= 0) & ctx.slabs["pat_member"][..., col]
-        safe = ctx.np.maximum(spath, 0)
-        return (spath >= 0) & ctx.pat_member[self.pattern_idx][safe]
+        width = ctx.pat_member.shape[1]
+        safe = ctx.np.clip(spath, 0, max(width - 1, 0))
+        base = (
+            (spath >= 0)
+            & (spath < width)
+            & ctx.pat_member[self.pattern_idx][safe]
+        )
+        if ctx.ov_member is None:
+            return base
+        loc = spath - ctx.v_base
+        b = ctx.ov_member.shape[0]
+        safe_loc = ctx.np.clip(loc, 0, max(b - 1, 0))
+        ov = (loc >= 0) & (loc < b) & ctx.ov_member[safe_loc, self.pattern_idx]
+        return ctx.np.where(loc >= 0, ov, base)
 
 
 @dataclass(eq=False)
@@ -254,10 +278,24 @@ class ECapture(Expr):
                 return ctx.np.where(
                     spath >= 0, ctx.slabs["pat_capture"][..., col], -1
                 )
-        safe = ctx.np.maximum(spath, 0)
-        return ctx.np.where(
-            spath >= 0, ctx.pat_capture[self.pattern_idx][safe], -1
+        width = ctx.pat_capture.shape[1]
+        safe = ctx.np.clip(spath, 0, max(width - 1, 0))
+        base = ctx.np.where(
+            (spath >= 0) & (spath < width),
+            ctx.pat_capture[self.pattern_idx][safe],
+            -1,
         )
+        if ctx.ov_capture is None:
+            return base
+        loc = spath - ctx.v_base
+        b = ctx.ov_capture.shape[0]
+        safe_loc = ctx.np.clip(loc, 0, max(b - 1, 0))
+        ov = ctx.np.where(
+            (loc >= 0) & (loc < b),
+            ctx.ov_capture[safe_loc, self.pattern_idx],
+            -1,
+        )
+        return ctx.np.where(loc >= 0, ov, base)
 
 
 @dataclass(eq=False)
@@ -290,8 +328,39 @@ class EStrTable(Expr):
                         )
         ids = self.ids.emit(ctx)
         tab = ctx.str_tables[self.table]
-        safe = ctx.np.maximum(ids, 0)
-        return ctx.np.where(ids >= 0, tab[safe], self.default)
+        rows = tab.shape[0]
+        safe = ctx.np.clip(ids, 0, max(rows - 1, 0))
+        base = ctx.np.where(
+            (ids >= 0) & (ids < rows), tab[safe], self.default
+        )
+        if ctx.v_base is None:
+            return base
+        ov_row = None
+        if ctx.ov_tabs is not None:
+            ovt = ctx.ov_tabs.get(self.table)
+            if ovt is not None:
+                loc = ids - ctx.v_base
+                b = ovt.shape[0]
+                safe_loc = ctx.np.clip(loc, 0, max(b - 1, 0))
+                ov_row = ctx.np.where(
+                    (loc >= 0) & (loc < b), ovt[safe_loc], self.default
+                )
+        elif ctx.ov_slabs is not None and ctx.ov_cols is not None:
+            kc = ctx.ov_cols.get(self.table)
+            if kc is not None:
+                kind, col = kc
+                ov = ctx.ov_slabs[kind]
+                loc = ids - ctx.v_base
+                b = ov.shape[0]
+                safe_loc = ctx.np.clip(loc, 0, max(b - 1, 0))
+                ov_row = ctx.np.where(
+                    (loc >= 0) & (loc < b),
+                    ov[safe_loc, col],
+                    self.default,
+                )
+        if ov_row is None:
+            return base
+        return ctx.np.where(ids - ctx.v_base >= 0, ov_row, base)
 
 
 @dataclass(eq=False)

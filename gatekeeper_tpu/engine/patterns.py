@@ -152,6 +152,32 @@ class PatternRegistry:
                     self._classify(pi, vid)
             self.generation += 1
 
+    def classify_overlay(
+        self, overlay, start: int, end: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (member [B, P] bool, capture [B, P] int32) rows for
+        overlay entries [start, end) — the ephemeral counterpart of
+        sync(), touching neither the base tables nor the base vocab.
+        Captured segments intern into the OVERLAY; the caller loops
+        while that grows it. Non-path entries (values) skip fast, so the
+        per-batch cost is #new-paths x P, typically tiny."""
+        P = len(self._patterns)
+        B = end - start
+        member = np.zeros((B, P), bool)
+        capture = np.full((B, P), -1, np.int32)
+        for j in range(B):
+            s = overlay.string(start + j)
+            if not s.startswith("p:"):
+                continue
+            segs = s[2:].split(".") if len(s) > 2 else []
+            for pi, pat in enumerate(self._patterns):
+                ok, cap = _match(pat.segs, segs)
+                if ok:
+                    member[j, pi] = True
+                    if cap is not None:
+                        capture[j, pi] = overlay.str_id(unesc_seg(cap))
+        return member, capture
+
     @property
     def member(self) -> np.ndarray:
         return self._member

@@ -109,12 +109,21 @@ class ProgramEvaluator:
             self._device_tables = (gen, arrs)
         return self._device_tables[1]
 
-    def eval_np(self, program: Program, tok: Dict[str, np.ndarray], g: int = 8):
+    def eval_np(
+        self,
+        program: Program,
+        tok: Dict[str, np.ndarray],
+        g: int = 8,
+        overlay: Optional[Dict[str, Any]] = None,
+    ):
+        """`overlay` (ephemeral batches): {"v_base", "member", "capture",
+        "tabs"} vocab-overlay blocks for ids >= v_base."""
         arrs = self._table_arrays()
         host = {
             k: (np.asarray(v) if not isinstance(v, np.ndarray) else v)
             for k, v in arrs.items()
         }
+        ov = overlay or {}
         ctx = EvalCtx(
             np=np,
             tok=tok,
@@ -128,6 +137,10 @@ class ProgramEvaluator:
             consts=program.consts,
             g0=g,
             g1=g,
+            v_base=ov.get("v_base"),
+            ov_member=ov.get("member"),
+            ov_capture=ov.get("capture"),
+            ov_tabs=ov.get("tabs"),
         )
         return np.asarray(program.expr.emit(ctx))
 

@@ -38,6 +38,10 @@ class _Table:
     # depth cutoff in _fill — without it, mutually prefixing transforms
     # grow the vocab exponentially under sync()'s fixed point
     is_transform: bool = False
+    # the raw str->str transform (transforms only): fill_overlay interns
+    # outputs into the OVERLAY, which the table_fn closure (bound to the
+    # base vocab) cannot do
+    raw_xf: Optional[Callable[[str], str]] = None
 
 
 class StrTables:
@@ -163,6 +167,56 @@ class StrTables:
             out[name + "!def"] = t.defined
         return out
 
+    def fill_overlay(
+        self, overlay, start: int, end: int
+    ) -> Dict[str, np.ndarray]:
+        """Per-table rows for overlay entries [start, end): the ephemeral
+        counterpart of _fill, never touching the base tables or vocab.
+        Transform outputs intern into the OVERLAY (raw_xf); the caller
+        loops while the overlay keeps growing. Depth bookkeeping mirrors
+        _fill: overlay-born transform products get depth input+1 and are
+        cut off at XF_MAX_DEPTH."""
+        names = list(self._tables)
+        cols: Dict[str, Tuple[list, list]] = {n: ([], []) for n in names}
+        depth = getattr(overlay, "_ov_xf_depth", None)
+        if depth is None:
+            depth = overlay._ov_xf_depth = {}
+        for i in range(start, end):
+            raw = overlay.string(i)
+            val = _decode_entry(raw)
+            for n in names:
+                t = self._tables[n]
+                v, d = 0, False
+                if val is not _SKIP:
+                    if t.is_transform:
+                        de = depth.get(i, 0)
+                        if de < XF_MAX_DEPTH and isinstance(val, str):
+                            try:
+                                out_s = t.raw_xf(val)
+                            except Exception:
+                                out_s = None
+                            if out_s is not None:
+                                oid = overlay.str_id(out_s)
+                                nd = de + 1
+                                if nd < depth.get(oid, 99):
+                                    depth[oid] = nd
+                                v, d = oid, True
+                    else:
+                        try:
+                            v, d = t.fn(val)
+                        except Exception:
+                            v, d = 0, False
+                vals, defs = cols[n]
+                vals.append(v if d else 0)
+                defs.append(d)
+        out: Dict[str, np.ndarray] = {}
+        for n in names:
+            t = self._tables[n]
+            vals, defs = cols[n]
+            out[n] = np.asarray(vals, t.dtype)
+            out[n + "!def"] = np.asarray(defs, bool)
+        return out
+
     # -- common predicate helpers ------------------------------------------
     # string builtins on non-string values are builtin errors in Rego
     # (-> undefined), so non-str entries stay defined=False
@@ -224,9 +278,12 @@ class StrTables:
                 self._xf_depth[oid] = d
             return oid, True
 
-        return self.register(
+        key = self.register(
             f"xf:{name}", table_fn, dtype=np.int32, is_transform=True
         )
+        if self._tables[key].raw_xf is None:
+            self._tables[key].raw_xf = fn
+        return key
 
 
 _SKIP = object()

@@ -146,3 +146,38 @@ class Vocab:
             hit = s.startswith(prefix)
             cache[entry_id] = hit
         return hit
+
+
+class OverlayVocab(Vocab):
+    """Ephemeral per-batch view over a base Vocab.
+
+    Strings already in the base resolve to their base ids; novel strings
+    intern LOCALLY with ids >= base_len and die with the overlay. This
+    is what keeps the admission path sustainable: every webhook batch
+    carries fresh object names, and interning them globally would grow
+    the vocab (and every [V]-shaped device table) forever — per-batch
+    table re-uploads and a memory leak. The driver ships the overlay's
+    tiny table/pattern rows alongside the batch instead
+    (StrTables.fill_overlay / PatternRegistry.classify_overlay), and the
+    kernels gather two-level (base tables for ids < base_len, overlay
+    blocks above).
+
+    Implementation: copies the base's intern structures (dict/list of
+    pointers — a few ms at 100k entries), so every Vocab method and the
+    native C encoder work unchanged; the base is never mutated. The
+    predicate caches start empty rather than shared — polluting the
+    base's caches with overlay ids would leave stale hits when the base
+    later grows into those ids."""
+
+    def __init__(self, base: Vocab):
+        self._ids = dict(base._ids)
+        self._strs = list(base._strs)
+        self._quantity = list(base._quantity)
+        self._regex_cache = {}
+        self._prefix_cache = {}
+        self._vid_quantity = dict(base._vid_quantity)
+        self.base_len = len(base._strs)
+
+    @property
+    def local_count(self) -> int:
+        return len(self._strs) - self.base_len

@@ -150,6 +150,11 @@ class StackedCorpus:
     # per-row feature planes ([K, chunk] bool device), e.g. the
     # inventory join-key duplication bits (stage_row_feats)
     row_dev: Dict[str, Any] = None
+    # ephemeral vocab-overlay blocks (webhook batches): "member"/
+    # "capture" [B, P] + per-kind [B, T] slabs; ids >= v_base resolve
+    # against these instead of the resident tables
+    ov_dev: Optional[Dict[str, Any]] = None
+    v_base: int = 0
 
 
 class FusedAuditKernel:
@@ -466,10 +471,17 @@ class FusedAuditKernel:
         self,
         chunks: Sequence[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray],
                                np.ndarray, int]],
+        ov: Optional[Dict[str, Any]] = None,
+        v_base: int = 0,
     ) -> StackedCorpus:
         """Stack per-chunk (fb, tok, row_fb, n_valid) onto a leading
         chunk axis and ship to device once. All chunks must share the
-        padded chunk shape."""
+        padded chunk shape.
+
+        `ov` (ephemeral batches): {"member": [B, P] bool, "capture":
+        [B, P] i32, "tabs": {name: [B]}} — the batch's vocab-overlay
+        rows. Per-kind slabs are stacked here in the SAME column order
+        as the resident fused tables so one col mapping serves both."""
         k = len(chunks)
         fb_dev = {
             key: self._put(
@@ -488,6 +500,28 @@ class FusedAuditKernel:
         for i, c in enumerate(chunks):
             row_fb[i, : len(c[2])] = c[2]
         n_valids = [c[3] for c in chunks]
+        ov_dev = None
+        ov_key: Tuple = ()
+        if ov is not None:
+            self._tables_device()  # ensure _fused_cols is current
+            ov_dev = {
+                "member": self._put(ov["member"]),
+                "capture": self._put(ov["capture"]),
+            }
+            b_pad = ov["member"].shape[0]
+            tabs = ov.get("tabs") or {}
+            for kind, cols in self._fused_cols.items():
+                if kind in ("pat_member", "pat_capture"):
+                    continue
+                dt = {"vid_bool": np.bool_, "vid_i32": np.int32,
+                      "vid_f32": np.float32}[kind]
+                slab = np.zeros((b_pad, len(cols)), dt)
+                for name, col in cols.items():
+                    t = tabs.get(name)
+                    if t is not None:
+                        slab[:, col] = t.astype(dt)
+                ov_dev[kind] = self._put(slab)
+            ov_key = (b_pad, tuple(sorted(ov_dev)))
         return StackedCorpus(
             fb_dev=fb_dev,
             tok_dev=tok_dev,
@@ -501,8 +535,11 @@ class FusedAuditKernel:
                 chunk,
                 tok_dev["spath"].shape,
                 fb_dev["group_id"].shape,
+                ov_key,
             ),
             row_dev={},
+            ov_dev=ov_dev,
+            v_base=v_base,
         )
 
     def stage_row_feats(
@@ -550,12 +587,13 @@ class FusedAuditKernel:
 
             def run_all(ms_in, spec_map, fb_in, tok_in, tabs_in,
                         consts_in, compiled_mask, row_fb, n_valid,
-                        row_in):
+                        row_in, ov_in, vb):
                 def body(xs):
                     fb_c, tok_c, rf_c, nv_c, row_c = xs
                     return need_chunk(
                         ms_in, spec_map, fb_c, tok_c, tabs_in,
                         consts_in, compiled_mask, rf_c, nv_c, row_c,
+                        ov_in=ov_in, v_base=vb,
                     )
 
                 packed, hot, n_hot, sc, si = jax.lax.map(
@@ -595,6 +633,8 @@ class FusedAuditKernel:
             corpus.row_fb,
             corpus.n_valid,
             row_dev,
+            corpus.ov_dev or {},
+            jnp.int32(corpus.v_base),
         )
         buf = np.asarray(out)  # ONE transfer for the whole sweep
         # unpack (see run_all): [pwords | hot | n_hot | sc | si]
@@ -621,7 +661,7 @@ class FusedAuditKernel:
 
         def need_chunk(ms_in, spec_map, fb_in, tok_in, tabs_in,
                        consts_in, compiled_mask, row_fb, n_valid,
-                       row_in=None):
+                       row_in=None, ov_in=None, v_base=None):
             from ..engine.exprs import EvalCtx
 
             # [U+1, N] over distinct specs, gathered back to [C_pad, N]
@@ -633,19 +673,53 @@ class FusedAuditKernel:
                 if k not in ("pat_member", "pat_capture")
                 and not k.endswith("!T")
             }
+            has_ov = bool(ov_in)
+
+            def two_level(base_tab, ov_tab, ids):
+                """Gather rows by id: base table below v_base, the
+                batch's overlay block above (ephemeral vocab ids)."""
+                rows = base_tab.shape[0]
+                base = base_tab[jnp.clip(ids, 0, rows - 1)]
+                if not has_ov or ov_tab is None:
+                    return base
+                loc = ids - v_base
+                b = ov_tab.shape[0]
+                ov = ov_tab[jnp.clip(loc, 0, b - 1)]
+                return jnp.where((loc >= 0)[..., None], ov, base)
+
             # fused pre-gathers, ONCE per chunk in the outer trace and
             # shared by every group and vmap lane (each expression node
             # slices its column); XLA DCEs any slab no node touches
             slabs = {}
             if "pat_member!T" in tabs_in:
                 safe_sp = jnp.maximum(tok_in["spath"], 0)
-                slabs["pat_member"] = tabs_in["pat_member!T"][safe_sp]
-                slabs["pat_capture"] = tabs_in["pat_capture!T"][safe_sp]
+                slabs["pat_member"] = two_level(
+                    tabs_in["pat_member!T"],
+                    ov_in.get("member") if has_ov else None,
+                    safe_sp,
+                )
+                slabs["pat_capture"] = two_level(
+                    tabs_in["pat_capture!T"],
+                    ov_in.get("capture") if has_ov else None,
+                    safe_sp,
+                )
             safe_vid = jnp.maximum(tok_in["vid"], 0)
             for kind in ("vid_bool", "vid_i32", "vid_f32"):
                 if kind + "!T" in tabs_in:
-                    slabs[kind] = tabs_in[kind + "!T"][safe_vid]
+                    slabs[kind] = two_level(
+                        tabs_in[kind + "!T"],
+                        ov_in.get(kind) if has_ov else None,
+                        safe_vid,
+                    )
             slab_cols = self._fused_cols
+            ov_cols = None
+            if has_ov:
+                ov_cols = {
+                    name: (kind, col)
+                    for kind, cols in self._fused_cols.items()
+                    if kind not in ("pat_member", "pat_capture")
+                    for name, col in cols.items()
+                }
             viol = jnp.zeros(match.shape, bool)
             for expr, grows, cmap, consts_k in zip(
                 group_exprs, group_rows, group_cmaps, consts_in
@@ -664,6 +738,9 @@ class FusedAuditKernel:
                         slabs=slabs,
                         slab_cols=slab_cols,
                         row=row_in,
+                        v_base=v_base if has_ov else None,
+                        ov_slabs=ov_in if has_ov else None,
+                        ov_cols=ov_cols,
                     )
                     return expr.emit(ctx).astype(jnp.int32)
 
@@ -711,6 +788,8 @@ class FusedAuditKernel:
         block: bool = True,
         r_cap: int = 4096,
         row_in: Optional[Dict[str, Any]] = None,
+        ov_in: Optional[Dict[str, Any]] = None,
+        v_base: int = 0,
     ) -> Tuple[Any, Any, Any, Any, Any]:
         """-> (packed hot-row need bits [C_pad x R / 8] uint8 c-major,
         hot row ids [R] int32, n_hot, compiled_pairs, interp_pairs) for
@@ -737,8 +816,9 @@ class FusedAuditKernel:
         n_pad = batch.tok_dev["spath"].shape[0]
         r_cap = min(r_cap, n_pad)
         row_in = row_in or {}
+        ov_in = ov_in or {}
         key = ("need", policy.key, batch.key, g, r_cap,
-               tuple(sorted(row_in)))
+               tuple(sorted(row_in)), tuple(sorted(ov_in)))
         entry = self._jit_cache.get(key)
         if entry is None:
             run_need = self._need_chunk_fn(policy, g, r_cap)
@@ -756,6 +836,8 @@ class FusedAuditKernel:
             batch.row_fb,
             jnp.int32(batch.n_valid),
             row_in,
+            ov_in,
+            jnp.int32(v_base),
         )
         if not block:
             return out

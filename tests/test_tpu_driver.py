@@ -250,7 +250,11 @@ def test_compiled_render_covers_library_mix(clients):
     The library mix above is all-exact, so every violating pair must
     host-render with zero degraded plan evaluations."""
     _, tpu, drv = clients
+    # invalidate the render cache (earlier tests may have populated it;
+    # cached pairs are neither host- nor interp-rendered)
+    tpu.add_data(pod("render-probe"))
     tpu.audit()
+    tpu.remove_data(pod("render-probe"))
     assert drv.stats["host_rendered_pairs"] > 0, drv.stats
     assert drv.stats["interp_rendered_pairs"] == 0, drv.stats
     assert drv.stats["render_errors"] == 0, drv.stats
