@@ -123,7 +123,11 @@ class AuditManager:
         cluster=None,
         audit_chunk_size: int = 512,
         excluder=None,
+        logger=None,
     ):
+        from ..logs import null_logger
+
+        self.log = logger if logger is not None else null_logger()
         self.client = client
         self.target = target
         self.audit_from_cache = audit_from_cache
@@ -158,10 +162,15 @@ class AuditManager:
         timestamp = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime(int(t0))
         )
+        # every record of this sweep shares the audit id
+        # (manager.go:148: am.log = log.WithValues(logging.AuditID, ts))
+        log = self.log.with_values(process="audit", audit_id=timestamp)
         if self.audit_from_cache or self.cluster is None:
+            log.info("Auditing from cache")
             resp = self.client.audit().by_target.get(self.target)
             results = resp.results if resp is not None else []
         else:
+            log.info("Auditing via discovery client")
             results = self._audit_resources()
 
         statuses: Dict[str, ConstraintStatus] = {}
@@ -198,6 +207,19 @@ class AuditManager:
                         namespace=meta.get("namespace", ""),
                     )
                 )
+            res_l = r.resource if isinstance(r.resource, dict) else {}
+            meta_l = res_l.get("metadata") or {}
+            # logViolation (manager.go:668-682)
+            log.info(
+                truncate_message(r.msg or "", self.msg_size),
+                event_type="violation_audited",
+                constraint_kind=ckind,
+                constraint_name=cname,
+                constraint_action=ea,
+                resource_kind=res_l.get("kind", ""),
+                resource_namespace=meta_l.get("namespace", ""),
+                resource_name=meta_l.get("name", ""),
+            )
             if self.emit_audit_events and self.event_sink is not None:
                 res = r.resource if isinstance(r.resource, dict) else {}
                 meta = res.get("metadata") or {}
@@ -226,6 +248,16 @@ class AuditManager:
             by_enforcement_action=totals_by_ea,
             statuses=statuses,
         )
+        log.info("audit results", violations=len(results))
+        for st in statuses.values():
+            # updateConstraintStatus log shape (manager.go:652-666)
+            log.debug(
+                "updating constraint status",
+                constraint_kind=st.constraint_kind,
+                constraint_name=st.constraint_name,
+                constraint_status="enforced",
+                constraint_violations=str(st.total_violations),
+            )
         self.sink.publish(report)
         self.last_run_seconds = t0
         self.audit_duration_seconds = duration

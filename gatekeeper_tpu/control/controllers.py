@@ -82,7 +82,10 @@ class TemplateController:
         metrics=None,
         status=None,
         constraint_controller: Optional["ConstraintController"] = None,
+        logger=None,
     ):
+        from ..logs import null_logger
+
         self.client = client
         self.watch_mgr = watch_mgr
         self.constraint_registrar = constraint_registrar
@@ -91,6 +94,7 @@ class TemplateController:
         self.metrics = metrics
         self.status = status
         self.constraint_controller = constraint_controller
+        self.log = logger if logger is not None else null_logger()
         self._lock = threading.Lock()
         self._kinds: Dict[str, str] = {}  # template name -> constraint kind
         self.errors: Dict[str, str] = {}  # template name -> last error
@@ -111,6 +115,12 @@ class TemplateController:
         except Exception as e:
             status = "error"
             self.errors[name] = str(e)
+            self.log.error(
+                "template ingest failed",
+                err=e,
+                process="controller",
+                template_name=name,
+            )
         if self.metrics is not None:
             self.metrics.observe(
                 "constraint_template_ingestion_duration_seconds",

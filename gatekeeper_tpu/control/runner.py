@@ -70,11 +70,17 @@ class Runner:
         emit_audit_events: bool = False,
         audit_from_cache: bool = True,
         enable_profiler: bool = False,
+        log_denies: bool = False,
+        logger=None,
     ):
+        from ..logs import null_logger
+
         self.cluster = cluster
         self.client = client
         self.target = target
         self.operations = set(operations)
+        self.log_denies = log_denies
+        self.log = logger if logger is not None else null_logger()
         if metrics is None:
             from ..metrics import MetricsRegistry
 
@@ -137,6 +143,7 @@ class Runner:
             metrics=metrics,
             status=self.status_writer,
             constraint_controller=self.constraint_controller,
+            logger=self.log,
         )
         self._template_registrar = self.watch_mgr.new_registrar(
             "template-controller", self.template_controller.sink
@@ -226,15 +233,14 @@ class Runner:
         self.upgrade_mgr = UpgradeManager(self.cluster)
         try:
             self.upgrade_mgr.upgrade()
-        except Exception:
+        except Exception as e:
             # upgrade failures must not block serving (the reference
             # logs and continues, upgrade/manager.go) — but they must
             # not be invisible either
-            import logging
-
-            logging.getLogger(__name__).exception(
+            self.log.error(
                 "stored-version upgrade failed; deprecated-version "
-                "objects may not be ingested"
+                "objects may not be ingested",
+                err=e,
             )
 
         self._populate_expectations()
@@ -263,6 +269,8 @@ class Runner:
                 trace_config=self.trace_config,
                 event_sink=self.events.append,
                 emit_admission_events=self.emit_admission_events,
+                log_denies=self.log_denies,
+                logger=self.log.with_values(process="webhook"),
             )
             self.webhook.start()
 
@@ -279,6 +287,7 @@ class Runner:
                 audit_from_cache=self.audit_from_cache,
                 cluster=self.cluster,
                 excluder=self.excluder,
+                logger=self.log,
             )
             self.audit.start()
 

@@ -105,12 +105,16 @@ class ValidationHandler:
         event_sink: Optional[Callable[[Dict[str, Any]], None]] = None,
         emit_admission_events: bool = False,
         trace_log: Optional[Callable[[str], None]] = None,
+        logger=None,
     ):
+        from ..logs import null_logger
+
         self.client = client
         self.target = target
         self.excluder = excluder
         self.namespace_getter = namespace_getter
         self.log_denies = log_denies
+        self.log = logger if logger is not None else null_logger()
         self.metrics = metrics
         self.trace_config = trace_config
         # violation event emission (--emit-admission-events,
@@ -227,6 +231,24 @@ class ValidationHandler:
                 "name", "?"
             )
             if r.enforcement_action in ("deny", "dryrun") and self.log_denies:
+                # --log-denies (policy.go:240-252): one structured
+                # record per violation with the reference's key set
+                self.log.info(
+                    "denied admission",
+                    process="admission",
+                    event_type="violation",
+                    constraint_name=cname,
+                    constraint_kind=(r.constraint or {}).get("kind", ""),
+                    constraint_action=r.enforcement_action,
+                    resource_kind=(request.get("kind") or {}).get(
+                        "kind", ""
+                    ),
+                    resource_namespace=request.get("namespace", ""),
+                    resource_name=request.get("name", ""),
+                    request_username=(request.get("userInfo") or {}).get(
+                        "username", ""
+                    ),
+                )
                 self.denied_log.append(
                     {
                         "process": "admission",

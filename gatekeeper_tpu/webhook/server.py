@@ -239,6 +239,7 @@ class WebhookServer:
         event_sink=None,
         emit_admission_events: bool = False,
         log_denies: bool = False,
+        logger=None,
     ):
         self.batcher = MicroBatcher(
             client, target, window_ms=window_ms,
@@ -251,6 +252,7 @@ class WebhookServer:
             event_sink=event_sink,
             emit_admission_events=emit_admission_events,
             log_denies=log_denies,
+            logger=logger,
         )
         self.label_handler = NamespaceLabelHandler(exempt_namespaces)
         outer = self

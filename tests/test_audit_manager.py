@@ -299,3 +299,40 @@ def test_audit_resources_attaches_namespaces_for_matching():
         v.name for st in direct.statuses.values() for v in st.violations
     ]
     assert names == ["p-prod"], names
+
+
+def test_audit_logs_structured_violations():
+    """Audit-sweep logging parity (manager.go:148 audit-id binding,
+    logViolation:668-682): one record per violation with the standard
+    keys, all carrying the sweep's audit_id."""
+    from gatekeeper_tpu.logs import CapturingLogger
+
+    client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    client.add_template(template("AuthLabels", REQ_LABELS))
+    client.add_constraint(
+        constraint(
+            "AuthLabels", "need-owner", {"labels": ["owner"], "note": "n"}
+        )
+    )
+    client.add_data(pod(1, {"app": "a"}))  # violating
+    log = CapturingLogger()
+    mgr = AuditManager(client, TARGET, sink=InMemorySink(), logger=log)
+    report = mgr.audit()
+    assert report.total_violations == 1
+    viols = [
+        r for r in log.records if r.get("event_type") == "violation_audited"
+    ]
+    assert len(viols) == 1
+    rec = viols[0]
+    assert rec["process"] == "audit"
+    assert rec["audit_id"] == report.timestamp
+    assert rec["constraint_kind"] == "AuthLabels"
+    assert rec["constraint_name"] == "need-owner"
+    assert rec["constraint_action"] == "deny"
+    assert rec["resource_kind"] == "Pod"
+    assert rec["resource_name"] == "p1"
+    # sweep summary record rides the same audit id
+    assert any(
+        r["msg"] == "audit results" and r["audit_id"] == report.timestamp
+        for r in log.records
+    )

@@ -139,6 +139,43 @@ def test_deny_and_dryrun(handler):
     assert dryrun_logs
 
 
+def test_log_denies_emits_structured_records(client):
+    """--log-denies parity (policy.go:240-252): every deny/dryrun
+    violation logs one JSON record with the reference's standard keys
+    (pkg/logging/logging.go)."""
+    import io
+    import json as _json
+
+    from gatekeeper_tpu.logs import StructuredLogger
+
+    buf = io.StringIO()
+    logger = StructuredLogger(stream=buf)
+    h = ValidationHandler(client, TARGET, log_denies=True, logger=logger)
+    resp = h.handle(admission_request(pod(labels={"app": "x"})))
+    assert not resp.allowed
+    records = [_json.loads(line) for line in buf.getvalue().splitlines()]
+    denies = [r for r in records if r["msg"] == "denied admission"]
+    assert denies, records
+    rec = denies[0]
+    for key in (
+        "process",
+        "event_type",
+        "constraint_name",
+        "constraint_kind",
+        "constraint_action",
+        "resource_kind",
+        "resource_namespace",
+        "resource_name",
+        "request_username",
+    ):
+        assert key in rec, rec
+    assert rec["process"] == "admission"
+    assert rec["event_type"] == "violation"
+    assert rec["constraint_kind"] == "ReqLabels"
+    # the dryrun constraint logs too (constraint_action distinguishes)
+    assert {r["constraint_action"] for r in denies} == {"deny", "dryrun"}
+
+
 def test_allow_compliant(handler):
     resp = handler.handle(
         admission_request(pod(labels={"owner": "me", "team": "t"}))
