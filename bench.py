@@ -112,6 +112,11 @@ ADVERSARIAL_EXTRA = [
      [{"allowedProfiles": ["runtime/default"]}], (("", "Pod"),)),
     (f"{LIB}/general/uniqueingresshost", "K8sUniqueIngressHost",
      [None], (("extensions", "Ingress"), ("networking.k8s.io", "Ingress"))),
+    # the volumes x volumeMounts x allowedHostPaths two-axis join,
+    # compiled exactly via element projection (VERDICT r3 #3)
+    (f"{LIB}/pod-security-policy/host-filesystem", "K8sPSPHostFilesystem",
+     [{"allowedHostPaths": [{"pathPrefix": "/var/log", "readOnly": True},
+                            {"pathPrefix": "/tmp"}]}], (("", "Pod"),)),
 ]
 
 
@@ -164,6 +169,29 @@ def make_pod(i, max_containers=1):
                 else "runtime/default"
             )
         meta["annotations"] = ann
+        # hostPath volumes + mounts exercise the host-filesystem
+        # two-axis join: ~1/3 of pods carry a hostPath (mostly inside
+        # the allowed prefixes; rare violators), mounts mostly readOnly
+        if i % 3 == 0:
+            path = (
+                "/etc/shadow" if i % 5021 == 0
+                else ("/var/log/app" if i % 2 else "/tmp/scratch")
+            )
+            vols = [
+                {"name": "data", "hostPath": {"path": path}},
+                {"name": "cache", "emptyDir": {}},
+            ]
+            ro = i % 5027 != 0  # rare writable mount on a readOnly path
+            containers[0]["volumeMounts"] = [
+                {"name": "data", "mountPath": "/data", "readOnly": ro},
+                {"name": "cache", "mountPath": "/cache"},
+            ]
+            return {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": meta,
+                "spec": {"containers": containers, "volumes": vols},
+            }
     return {
         "apiVersion": "v1",
         "kind": "Pod",

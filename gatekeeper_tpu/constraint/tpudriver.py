@@ -263,9 +263,17 @@ class TpuDriver(RegoDriver):
             return lambda fn_name, value: (None, False)
         ctx = self.interp.make_context({"parameters": params}, {})
 
-        def oracle_fn(fn_name: str, value: Any):
+        def oracle_fn(fn_name: str, value: Any, extra=None):
+            if extra is not None:
+                # multi-arg tableized call: consts with the per-vocab
+                # value substituted at the symbolic slot
+                sym_idx, consts = extra
+                call_args = [freeze(c) for c in consts]
+                call_args[sym_idx] = freeze(value)
+            else:
+                call_args = [freeze(value)]
             try:
-                v = _call_function(ctx, None, node, fn_name, [freeze(value)])
+                v = _call_function(ctx, None, node, fn_name, call_args)
             except RegoError:
                 return None, False
             if v is Undefined:

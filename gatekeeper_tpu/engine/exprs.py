@@ -561,6 +561,42 @@ def _onehot(ctx, idx, live, g):
 
 
 @dataclass(eq=False)
+class EGatherElem(Expr):
+    """[N, L]: for each token, the per-element value of ITS OWN array
+    element — the inverse of EGroup. `elem` lives on ("g0",) (indexed by
+    idx0) or ("g01",) (idx0*G1+idx1). Tokens outside the axis (idx -1)
+    take `default`.
+
+    This is what makes element-projected joins work: conditions on two
+    DIFFERENT tokens of one element (a mount's name and its readOnly
+    flag) become token-level expressions that agree across the element's
+    tokens, so they AND correctly and reduce existentially."""
+
+    elem: Expr
+    default: Any = False
+
+    def __post_init__(self):
+        if self.elem.space not in (("g0",), ("g01",)):
+            raise ValueError(f"gather from space {self.elem.space}")
+        self.space = ("tok",)
+
+    def _emit(self, ctx):
+        np = ctx.np
+        v = self.elem.emit(ctx)
+        if self.elem.space == ("g0",):
+            idx = ctx.tok["idx0"]
+            g = ctx.g0
+        else:
+            i0 = ctx.tok["idx0"]
+            i1 = ctx.tok["idx1"]
+            idx = np.where((i0 >= 0) & (i1 >= 0), i0 * ctx.g1 + i1, -1)
+            g = ctx.g0 * ctx.g1
+        safe = np.clip(idx, 0, g - 1)
+        vals = np.take_along_axis(v, safe, axis=1)
+        return np.where((idx >= 0) & (idx < g), vals, self.default)
+
+
+@dataclass(eq=False)
 class EGroupPresent(Expr):
     """[N, G] bool: any selected token exists at that array index."""
 

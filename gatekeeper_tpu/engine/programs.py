@@ -50,16 +50,25 @@ def compile_program(
         comp = Compiler(env, modules, params)
         expr = comp.compile_violation_counts()
     except CompileUnsupported:
-        # retry as a screen: uncompilable calls/comprehensions become
-        # opaque and conditions on them drop — a sound over-approximation
-        # whose flagged pairs the driver re-checks via the interpreter.
-        # This keeps inventory joins (uniqueingresshost/-serviceselector)
-        # and intra-object joins (seccomp/apparmor annotation matching)
-        # on the device path for the dense non-matching bulk.
-        comp = Compiler(env, modules, params, screen_mode=True)
-        expr = comp.compile_violation_counts()
-        comp.uses_inventory = True
-        comp.opaque = True  # retried programs' conditions over-approximate
+        try:
+            # element projection may have aborted (a second-array join
+            # whose conditions could not reduce existentially): retry
+            # exact with projection off — conflicted iterations take the
+            # flag-guarded object branch instead
+            comp = Compiler(env, modules, params, elem_projection=False)
+            expr = comp.compile_violation_counts()
+        except CompileUnsupported:
+            # retry as a screen: uncompilable calls/comprehensions become
+            # opaque and conditions on them drop — a sound
+            # over-approximation whose flagged pairs the driver re-checks
+            # via the interpreter. This keeps inventory joins
+            # (uniqueingresshost/-serviceselector) and intra-object joins
+            # (seccomp/apparmor annotation matching) on the device path
+            # for the dense non-matching bulk.
+            comp = Compiler(env, modules, params, screen_mode=True)
+            expr = comp.compile_violation_counts()
+            comp.uses_inventory = True
+            comp.opaque = True  # retried conditions over-approximate
     env.patterns.sync()
     env.tables.sync()
     sig = tuple(

@@ -283,8 +283,8 @@ class SScalar(SVal):
         return self.col("kind", -1)
 
     def truthy(self) -> Expr:
-        if self.num_override is not None:
-            return self.exists()  # derived numbers: defined => truthy
+        # vid first: projected subfields carry BOTH overrides (vid for
+        # identity, num for arithmetic) and `false` must stay non-truthy
         if self.vid_override is not None:
             return e_and(
                 self.exists(),
@@ -292,6 +292,8 @@ class SScalar(SVal):
                     e_cmp("==", self.vid_override, ELit(self.comp.false_id))
                 ),
             )
+        if self.num_override is not None:
+            return self.exists()  # derived numbers: defined => truthy
         false_id = ELit(self.comp.false_id)
         if self.tok_space:
             return e_and(
@@ -372,6 +374,24 @@ class STokenSet(SVal):
 
 
 @dataclass
+class SElemProj(SVal):
+    """Element projection of a SECOND array iterated in token space.
+
+    When a clause's group axis is already owned by another array (the
+    host-filesystem volumes x volumeMounts join), the second array's
+    elements are represented by their subtree TOKENS: subfield reads
+    gather the element's per-field values back onto each token
+    (EGatherElem), so conditions on different fields of one element
+    agree token-wise. Sound only under EXISTENTIAL reduction (function
+    bodies, negations) — one element spans many tokens, so counting
+    heads over projected conditions would over-count; the `proj` taint
+    on State enforces the restriction."""
+
+    root: Tuple[str, ...]  # ends with "#": the element's array marker
+    rel: Tuple[str, ...] = ()  # walked segments below the element
+
+
+@dataclass
 class SDerived(SVal):
     """Per-resource derived number (e.g. a count)."""
 
@@ -409,6 +429,11 @@ class State:
     # axis -> owning array prefix: two DIFFERENT arrays may not share a
     # group axis in one clause (their indices would silently mis-join)
     axis_owner: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    # element-projection taint (SElemProj): conds are per-TOKEN stand-ins
+    # for per-element truth, valid only once existentially reduced
+    # (_eval_not); a tainted state reaching a counting head aborts the
+    # compile (programs retry with projection disabled)
+    proj: bool = False
 
 
 def _space_join(a: Tuple[str, ...], b: Tuple[str, ...]) -> Tuple[str, ...]:
@@ -516,12 +541,17 @@ class Compiler:
         modules: Sequence[A.Module],
         params: Any,
         screen_mode: bool = False,
+        elem_projection: bool = True,
     ):
         # screen mode: calls/comprehensions outside the compilable
         # subset become opaque SInventory values instead of aborting —
         # the program over-approximates and flagged pairs re-check via
         # the interpreter (compile_program's fallback retry)
         self.screen_mode = screen_mode
+        # element projection (SElemProj): compile second-array joins in
+        # token space; off in the middle retry of compile_program's
+        # chain (a projection that cannot reduce existentially aborts)
+        self.elem_projection = elem_projection
         self.cenv = env
         self.vocab = env.vocab
         self.patterns = env.patterns
@@ -672,6 +702,11 @@ class Compiler:
                     join_refine, f
                 )
         self.out_flags.extend(clause_flags)
+        if any(st.proj for st in finals):
+            # element-projected conditions reached the counting head:
+            # one element spans many tokens, so the count would inflate.
+            # Abort; compile_program retries with projection disabled.
+            raise CompileUnsupported("unreduced element projection")
         outs: List[Tuple[Any, Tuple[str, ...], Expr, Optional[Expr], Any]] = []
         for st in finals:
             # the head must evaluate too (undefined heads drop violations);
@@ -950,6 +985,15 @@ class Compiler:
                 raise
         if not finals:
             return [st]  # statically undefined -> `not` succeeds
+        if (
+            any(f.proj for f in finals)
+            and "tok" in st.space
+            and not st.proj
+        ):
+            # the negation cannot existentially close the projection's
+            # token axis (the outer space already holds an UNRELATED
+            # token iteration) — mixing their token conds would misjoin
+            raise CompileUnsupported("projection under open token axis")
         exprs = []
         statically_true = False
         for f in finals:
@@ -1212,6 +1256,8 @@ class Compiler:
                     )
                     self.uses_inventory = True
             return []
+        if isinstance(val, SElemProj):
+            return self._walk_elem_proj(val, op, st)
         if isinstance(val, STokenSet):
             if isinstance(op, (A.Var, A.Wildcard)) and not (
                 isinstance(op, A.Var) and op.name in st.env
@@ -1417,16 +1463,24 @@ class Compiler:
             )
             forks.append((scalar, st2))
             if axis_conflict:
-                # object-only handling of a maybe-array node: rows where
-                # it IS an array must route (Rego would bind indices
-                # there; "*" never matches the "#" marker so the object
-                # branch sees nothing — an under-approximation without
-                # this flag)
-                arr_pat = self._pattern(node.prefix + ("#", "**"))
-                self._force_flags.append(
-                    EReduce(ESelPattern(arr_pat), "any")
-                )
-                self.uses_inventory = True
+                if self.elem_projection:
+                    # ARRAY handling without a free group axis: iterate
+                    # in token space via element projection. The object
+                    # and projection forks select DISJOINT tokens
+                    # ("*" never matches "#"), so emitting both is exact
+                    # whichever shape a row actually holds — no safety
+                    # flag, no interpreter routing.
+                    forks.append(self._elem_proj_fork(node, bind, st))
+                else:
+                    # projection disabled (retry path): rows where the
+                    # node IS an array must route (Rego would bind
+                    # indices there; the object branch sees nothing —
+                    # an under-approximation without this flag)
+                    arr_pat = self._pattern(node.prefix + ("#", "**"))
+                    self._force_flags.append(
+                        EReduce(ESelPattern(arr_pat), "any")
+                    )
+                    self.uses_inventory = True
         if not forks:
             if "tok" in st.space:
                 # we're inside the phantom object-branch of an earlier
@@ -1435,6 +1489,116 @@ class Compiler:
                 return []
             raise CompileUnsupported("iteration not representable")
         return forks
+
+    def _elem_proj_fork(
+        self, node: SNode, bind: Optional[str], st: State
+    ) -> Tuple[SVal, State]:
+        root = node.prefix + ("#",)
+        elem_any = self._pattern(root + ("**",))
+        val = SElemProj(root=root, rel=())
+        env = dict(st.env)
+        if bind:
+            env[bind] = val
+        st2 = replace(
+            st,
+            env=env,
+            space=_space_join(st.space, ("tok",)),
+            cond=st.cond + [ESelPattern(elem_any)],
+            proj=True,
+        )
+        return (val, st2)
+
+    def _walk_elem_proj(self, val: SElemProj, op: A.Term, st: State):
+        if isinstance(op, A.Scalar):
+            if not isinstance(op.value, str):
+                raise CompileUnsupported("indexed walk under projection")
+            return [
+                (replace(val, rel=val.rel + (esc_seg(op.value),)), st)
+            ]
+        if isinstance(op, (A.Wildcard, A.Var)) and not (
+            isinstance(op, A.Var) and op.name in st.env
+        ):
+            # nested array under the projected element (volumeMounts[_])
+            root2 = val.root + val.rel + ("#",)
+            if root2.count("#") > 2:
+                raise CompileUnsupported(">2 array levels in projection")
+            elem_any = self._pattern(root2 + ("**",))
+            child = SElemProj(root=root2, rel=())
+            env = dict(st.env)
+            if isinstance(op, A.Var):
+                env[op.name] = child
+            st2 = replace(
+                st,
+                env=env,
+                space=_space_join(st.space, ("tok",)),
+                cond=st.cond + [ESelPattern(elem_any)],
+                proj=True,
+            )
+            return [(child, st2)]
+        raise CompileUnsupported("projection walk op")
+
+    def _elem_proj_scalar(self, v: SElemProj) -> SScalar:
+        """Projected subfield read: the element's per-field value
+        gathered onto each of the element's tokens (see SElemProj)."""
+        from .exprs import EGatherElem
+
+        if not v.rel:
+            raise CompileUnsupported("whole projected element as value")
+        ax = "g0" if v.root.count("#") == 1 else "g01"
+        pat_f = self._pattern(v.root + v.rel)
+        elem_any = self._pattern(v.root + ("**",))
+        grp_sel = ESelPattern(pat_f)
+        vid_tok = EGatherElem(
+            EGroup(grp_sel, ETokCol("vid"), ax, how="max", init=-1),
+            default=-1,
+        )
+        ex_tok = e_and(
+            ESelPattern(elem_any),
+            EGatherElem(
+                EGroup(grp_sel, None, ax, how="any"), default=False
+            ),
+        )
+        num_tok = EGatherElem(
+            EGroup(grp_sel, ETokCol("vnum"), ax, how="max", init=NEG_INF),
+            default=NEG_INF,
+        )
+        return SScalar(
+            self,
+            pattern_idx=pat_f,
+            axes=(),
+            tok_space=True,
+            sel_override=ex_tok,
+            vid_override=vid_tok,
+            num_override=num_tok,
+            exists_override=ex_tok,
+        )
+
+    def _elem_proj_truthy(self, v: SElemProj) -> Expr:
+        """Projected-subfield truthiness (`mount.readOnly`,
+        has_field-style object checks): the element has ANY token at or
+        under the subfield path and its exact leaf is not `false` —
+        _node_truthy's semantics, element-gathered onto tokens."""
+        from .exprs import EGatherElem
+
+        if not v.rel:
+            raise CompileUnsupported("bare projected element truthiness")
+        ax = "g0" if v.root.count("#") == 1 else "g01"
+        deep = self._pattern(v.root + v.rel + ("**",))
+        exact = self._pattern(v.root + v.rel)
+        elem_any = self._pattern(v.root + ("**",))
+        deep_any = EGatherElem(
+            EGroup(ESelPattern(deep), None, ax, how="any"), default=False
+        )
+        false_leaf = e_and(
+            ESelPattern(exact),
+            e_cmp("==", ETokCol("vid"), ELit(self.false_id)),
+        )
+        has_false = EGatherElem(
+            EGroup(false_leaf, None, ax, how="any"), default=False
+        )
+        return e_and(
+            ESelPattern(elem_any), e_and(deep_any, e_not(has_false))
+        )
 
     def _node_leaf(self, node: SNode) -> SScalar:
         if "*" in node.prefix:
@@ -1531,6 +1695,7 @@ class Compiler:
                     space=hs.space,
                     guards=hs.guards,
                     axis_owner=hs.axis_owner,
+                    proj=st.proj or hs.proj,
                 )
                 env = dict(merged.env)
                 if isinstance(op, A.Var) and op.name not in st.env:
@@ -1668,6 +1833,7 @@ class Compiler:
                             cond=st.cond + hs.cond,
                             space=hs.space,
                             guards=hs.guards,
+                            proj=st.proj or hs.proj,
                         )
                         out.append((hv, merged))
             return out
@@ -1675,17 +1841,35 @@ class Compiler:
             self._fn_depth -= 1
 
     def _tableize_function(self, name: str, args: List[SVal], st: State):
-        """Pure single-scalar-arg helper -> per-vocab-entry value table."""
-        if self.cenv.oracle_fn is None or len(args) != 1:
+        """Pure helper with exactly ONE symbolic scalar argument (the
+        rest constants) -> per-vocab-entry value table. The constants
+        fold into the table identity, so e.g. host-filesystem's
+        `path_matches(<const prefix>, volume.hostPath.path)` becomes
+        one boolean table over distinct path strings per prefix."""
+        if self.cenv.oracle_fn is None or not args:
             return None
-        arg = self._leafify(args[0])
+        sym_idx = -1
+        consts: List[Any] = []
+        for i, a in enumerate(args):
+            if isinstance(a, SConst):
+                if not _jsonable(a.value):
+                    return None
+                consts.append(a.value)
+                continue
+            if sym_idx >= 0:
+                return None  # at most one symbolic slot
+            sym_idx = i
+            consts.append(None)
+        if sym_idx < 0:
+            return None
+        arg = self._leafify(args[sym_idx])
         if not isinstance(arg, (SScalar, SKey)):
             return None
         if isinstance(arg, SScalar) and arg.num_override is not None:
             return None
         if not self._fn_is_pure(name, set()):
             return None
-        if not self._fn_arg_scalar(name):
+        if not self._fn_arg_scalar(name, sym_idx=sym_idx):
             return None
         oracle = self.cenv.oracle_fn
         ns = self.cenv.oracle_ns
@@ -1706,9 +1890,23 @@ class Compiler:
         persist_key = f"v{ORACLE_MEMO_VERSION}|{self._rules_hash}|{name}"
         if reads_params:
             persist_key += f"|{json.dumps(self.params, sort_keys=True, default=str)}"
+        table_id = f"fn:{ns}:{name}"
+        call_extra = None
+        if len(args) > 1:
+            # fold the constant arguments into the table identity: one
+            # table per (function, const combination)
+            cjson = json.dumps(consts, sort_keys=True, default=str)
+            import hashlib as _hl
+
+            chash = _hl.sha256(cjson.encode()).hexdigest()[:16]
+            table_id += f":{sym_idx}:{chash}"
+            persist_key += f"|{sym_idx}|{cjson}"
+            call_extra = (sym_idx, consts)
         tname = self.tables.register(
-            f"fn:{ns}:{name}",
-            lambda v, _n=name, _o=oracle: _numeric_oracle(_o, _n, v),
+            table_id,
+            lambda v, _n=name, _o=oracle, _e=call_extra: _numeric_oracle(
+                _o, _n, v, extra=_e
+            ),
             dtype="float64",
             persist_key=persist_key,
         )
@@ -1723,12 +1921,16 @@ class Compiler:
         dfn = e_and(base_def, EStrTable(tname + "!def", ids, default=False))
         return [(SDerived(num=num, defined=dfn), st)]
 
-    def _fn_arg_scalar(self, name: str) -> bool:
-        """True if the function only uses its formals as scalars (never
-        walks into them) — required for vid-keyed tableization."""
+    def _fn_arg_scalar(self, name: str, sym_idx: int = 0) -> bool:
+        """True if the function never walks into its SYMBOLIC formal
+        (required for vid-keyed tableization; const formals pass whole
+        frozen values to the oracle, so walking them is fine)."""
         for rule in self.rules.get(name, []):
+            head_args = rule.head.args or []
             formals = {
-                f.name for f in (rule.head.args or []) if isinstance(f, A.Var)
+                f.name
+                for i, f in enumerate(head_args)
+                if isinstance(f, A.Var) and i == sym_idx
             }
             bad = []
 
@@ -2207,7 +2409,13 @@ class Compiler:
         if isinstance(lv, SKey) and isinstance(rv, SKey):
             return e_cmp("==", lv.ids(), rv.ids()), True
         if isinstance(lv, SScalar) and isinstance(rv, SScalar):
-            if lv.num_override is None and rv.num_override is None:
+            # vid identity is exact whenever both sides HAVE a vid: a
+            # num_override alone marks a derived number (no vid), but
+            # projected subfields carry BOTH overrides and must compare
+            # by typed id, not by lossy vnum
+            l_vid = lv.num_override is None or lv.vid_override is not None
+            r_vid = rv.num_override is None or rv.vid_override is not None
+            if l_vid and r_vid:
                 return (
                     e_and(
                         e_and(lv.exists(), rv.exists()),
@@ -2296,6 +2504,8 @@ class Compiler:
             return v.truthy()
         if isinstance(v, SNode):
             return self._node_truthy(v)
+        if isinstance(v, SElemProj):
+            return self._elem_proj_truthy(v)
         if isinstance(v, (SMsg, SKey, STokenSet, SList)):
             return True
         raise CompileUnsupported(f"truthiness {type(v).__name__}")
@@ -2372,6 +2582,13 @@ class Compiler:
                     # never collide with interned string/value ids, so this
                     # branch's contribution to set algebra is empty
                     continue
+                if hs.proj and not st.proj:
+                    # projected conds are per-token stand-ins; a set
+                    # comprehension would materialize per-token
+                    # duplicates (count() over it would inflate)
+                    raise CompileUnsupported(
+                        "element projection in comprehension"
+                    )
                 inner_conds = list(hs.cond)
                 if isinstance(hv, SKey):
                     mask: Expr = ESelPattern(hv.pattern_idx)
@@ -2823,6 +3040,8 @@ class Compiler:
         consumed (builtin args, comparisons)."""
         if isinstance(v, SNode):
             return self._node_leaf(v)
+        if isinstance(v, SElemProj):
+            return self._elem_proj_scalar(v)
         return v
 
     def _string_ids(self, v: SVal) -> Tuple[Expr, Expr]:
@@ -2909,10 +3128,23 @@ def _to_number_host(v):
         return 0.0, False
 
 
-def _numeric_oracle(oracle, name: str, value):
-    """Adapter: oracle result must be numeric to live in a float table."""
+def _jsonable(v) -> bool:
     try:
-        res, defined = oracle(name, value)
+        json.dumps(v, sort_keys=True)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def _numeric_oracle(oracle, name: str, value, extra=None):
+    """Adapter: oracle result must be numeric to live in a float table.
+    `extra` = (sym_idx, consts): multi-arg call with the per-vocab value
+    substituted at sym_idx."""
+    try:
+        if extra is not None:
+            res, defined = oracle(name, value, extra=extra)
+        else:
+            res, defined = oracle(name, value)
     except Exception:
         return 0.0, False
     if not defined:

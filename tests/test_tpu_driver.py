@@ -793,3 +793,89 @@ def test_batched_autoreject_parity_on_device_path():
     names = [(r.constraint.get("metadata") or {}).get("name")
              for r in rejected]
     assert names == ["sel-a", "sel-b"]
+
+
+def test_host_filesystem_exact_two_axis_join():
+    """VERDICT r4 #3: host-filesystem's volumes x volumeMounts x
+    allowedHostPaths join compiles exactly — the second array iterates
+    via element projection (engine/symbolic.SElemProj + EGatherElem),
+    path_matches tableizes with its constant prefix folded in, and no
+    pair ever routes to the interpreter (interp_pairs == 0)."""
+    import itertools
+
+    tdir = f"{LIB}/pod-security-policy/host-filesystem"
+
+    def hf_pod(name, volumes, containers, init=None):
+        spec = {"volumes": volumes, "containers": containers}
+        if init:
+            spec["initContainers"] = init
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": spec,
+        }
+
+    vol_opts = [
+        [],
+        [{"name": "v1", "hostPath": {"path": "/foo"}}],
+        [{"name": "v1", "hostPath": {"path": "/foo/bar"}},
+         {"name": "v2", "emptyDir": {}}],
+        [{"name": "v1", "hostPath": {"path": "/fool"}}],
+        [{"name": "v1", "hostPath": {"path": "/var/log/x"}},
+         {"name": "v2", "hostPath": {"path": "/foo"}}],
+    ]
+    ctr_opts = [
+        [{"name": "c", "image": "x"}],
+        [{"name": "c", "image": "x",
+          "volumeMounts": [{"name": "v1", "mountPath": "/m"}]}],
+        [{"name": "c", "image": "x",
+          "volumeMounts": [{"name": "v1", "mountPath": "/m",
+                            "readOnly": True}]}],
+        [{"name": "c", "image": "x",
+          "volumeMounts": [{"name": "v2", "mountPath": "/m"}]},
+         {"name": "d", "image": "y",
+          "volumeMounts": [{"name": "v1", "mountPath": "/m",
+                            "readOnly": True},
+                           {"name": "v1", "mountPath": "/m2"}]}],
+    ]
+    pods = [
+        hf_pod(f"hf{i}", vs, cs)
+        for i, (vs, cs) in enumerate(itertools.product(vol_opts, ctr_opts))
+    ]
+    pods.append(
+        hf_pod(
+            "hfinit",
+            [{"name": "v1", "hostPath": {"path": "/foo"}}],
+            [{"name": "c", "image": "x"}],
+            init=[{"name": "ic", "image": "x",
+                   "volumeMounts": [{"name": "v1", "mountPath": "/m"}]}],
+        )
+    )
+    for params in (
+        None,
+        {"allowedHostPaths": [{"pathPrefix": "/foo"}]},
+        {"allowedHostPaths": [{"pathPrefix": "/foo", "readOnly": True},
+                              {"pathPrefix": "/var/log"}]},
+    ):
+        tpu_driver = TpuDriver()
+        clients = []
+        for drv in (RegoDriver(), tpu_driver):
+            cl = Backend(drv).new_client(K8sValidationTarget())
+            cl.add_template(load_template(tdir))
+            cl.add_constraint(
+                make_constraint(
+                    "K8sPSPHostFilesystem", "hf", params=params,
+                    match={"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+                )
+            )
+            for p in pods:
+                cl.add_data(p)
+            clients.append(cl)
+        rego, tpu = clients
+        want = rego.audit().by_target[TARGET].results
+        got = tpu.audit().by_target[TARGET].results
+        assert canon(got) == canon(want), f"params={params}"
+        assert len(want) > 0
+        assert tpu_driver.stats["interp_pairs"] == 0, tpu_driver.stats
+        assert tpu_driver.stats["render_errors"] == 0, tpu_driver.stats
