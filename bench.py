@@ -97,14 +97,11 @@ TEMPLATE_MIX = [
 # container joins — compiled precisely via the rank-3 token/container
 # join — and the uniqueingresshost data.inventory cross-object join,
 # screened sharply by the invdup row feature).
-# uniqueserviceselector stays OUT of the 100k bench mix deliberately:
-# its join key is a derived string (flatten_selector) the screen cannot
-# refine, and its Rego iterates EVERY namespaced object per flagged
-# service (data.inventory.namespace[ns][_][_][name]) so each exact
-# interpreter render is O(corpus) — seconds per service at 100k scale
-# in ANY engine that evaluates the template as written (the reference's
-# audit pays the same cross-join). It remains compiled+tested at unit
-# scale (tests/test_tpu_driver.py::test_inventory_join_screens_exact_parity).
+# uniqueserviceselector's Rego iterates EVERY namespaced object per
+# flagged service (data.inventory.namespace[ns][_][_][name]); its
+# renders go through the derived-key prune index (flatten_selector ->
+# candidate services, tpudriver._render_pruned), so each flagged
+# service costs O(candidates), not O(corpus) — VERDICT r3 #4.
 ADVERSARIAL_EXTRA = [
     (f"{LIB}/pod-security-policy/seccomp", "K8sPSPSeccomp",
      [{"allowedProfiles": ["runtime/default"]}], (("", "Pod"),)),
@@ -112,6 +109,8 @@ ADVERSARIAL_EXTRA = [
      [{"allowedProfiles": ["runtime/default"]}], (("", "Pod"),)),
     (f"{LIB}/general/uniqueingresshost", "K8sUniqueIngressHost",
      [None], (("extensions", "Ingress"), ("networking.k8s.io", "Ingress"))),
+    (f"{LIB}/general/uniqueserviceselector", "K8sUniqueServiceSelector",
+     [None], (("", "Service"),)),
     # the volumes x volumeMounts x allowedHostPaths two-axis join,
     # compiled exactly via element projection (VERDICT r3 #3)
     (f"{LIB}/pod-security-policy/host-filesystem", "K8sPSPHostFilesystem",

@@ -202,6 +202,10 @@ class TpuDriver(RegoDriver):
         # vs interpreter fallback evaluations in the last query
         self.stats: Dict[str, int] = {}
         self._render_errors = 0  # compiled-render bugs degraded to interp
+        # derived-key prune render caches (uniqueserviceselector-style
+        # joins): key index per data generation + oracle contexts
+        self._prune_indexes: Dict[Tuple, Tuple[int, Any]] = {}
+        self._prune_oracles: Dict[Tuple, Any] = {}
 
     # -- module/data bookkeeping (cache invalidation) ------------------------
 
@@ -227,6 +231,11 @@ class TpuDriver(RegoDriver):
     def _drop_programs(self, target: str, kind: str) -> None:
         for key in [k for k in self._programs if k[0] == target and k[1] == kind]:
             del self._programs[key]
+        for cache in (self._prune_oracles, self._prune_indexes):
+            for key in [
+                k for k in cache if k[0] == target and k[1] == kind
+            ]:
+                del cache[key]
         self._cset.pop(target, None)
 
     def put_data(self, path: str, data: Any) -> None:
@@ -926,6 +935,7 @@ class TpuDriver(RegoDriver):
             n_results = 0
             n_host = 0
             n_interp_render = 0
+            n_pruned = 0
             frozen: Dict[int, Any] = {}  # review idx -> frozen review
             for n_i, c_i in pairs:
                 out = None
@@ -939,10 +949,19 @@ class TpuDriver(RegoDriver):
                         fr = frozen.get(n_i)
                         if fr is None:
                             fr = frozen[n_i] = freeze(reviews[n_i])
-                        out = self._eval_template(
-                            target, cs.constraints[c_i], reviews[n_i],
-                            inventory, trace, frozen_review=fr
-                        )
+                        prog = cs.programs[c_i]
+                        prune = prog.prune if prog is not None else None
+                        if prune is not None:
+                            out = self._render_pruned(
+                                target, cs.constraints[c_i],
+                                reviews[n_i], prune, trace, fr
+                            )
+                            n_pruned += 1
+                        else:
+                            out = self._eval_template(
+                                target, cs.constraints[c_i], reviews[n_i],
+                                inventory, trace, frozen_review=fr
+                            )
                         n_interp_render += 1
                     if render_cache is not None:
                         render_cache[(n_i, c_i)] = out
@@ -956,6 +975,7 @@ class TpuDriver(RegoDriver):
                 "n_results": n_results,
                 "host_rendered_pairs": n_host,
                 "interp_rendered_pairs": n_interp_render,
+                "pruned_renders": n_pruned,
                 "render_errors": self._render_errors,
             }
             if trace is not None:
@@ -964,6 +984,90 @@ class TpuDriver(RegoDriver):
                     f"pairs, {self.stats['interp_pairs']} interpreter pairs"
                 )
             return per_review
+
+    # -- derived-key prune rendering -----------------------------------------
+
+    def _prune_oracle(self, target: str, kind: str, params: Any):
+        key = (target, kind, _params_key(params))
+        cached = self._prune_oracles.get(key)
+        if cached is None:
+            cached = self._make_oracle(target, kind, params)
+            self._prune_oracles[key] = cached
+        return cached
+
+    def _prune_index(
+        self, target: str, kind: str, params: Any, plan: Dict[str, Any]
+    ):
+        """{frozen F(obj) -> [(path segs, obj)]} over the inventory tree
+        — built once per data generation by evaluating the join's pure
+        helper host-side (the reference re-evaluates it per object per
+        query inside OPA; vendored flatten_selector in
+        /root/reference/library/general/uniqueserviceselector/src.rego)."""
+        ikey = (target, kind, _params_key(params), plan["fn"], plan["tree"])
+        cached = self._prune_indexes.get(ikey)
+        if cached is not None and cached[0] == self._data_gen:
+            return cached[1]
+        oracle = self._prune_oracle(target, kind, params)
+        depth = 4 if plan["tree"] == "namespace" else 3
+        tree = self.storage.get(["external", target, plan["tree"]], {})
+        index: Dict[Any, List[Tuple[Tuple[str, ...], Any]]] = {}
+
+        def rec(node, segs):
+            if len(segs) == depth:
+                k, defined = oracle(plan["fn"], node)
+                if defined:
+                    index.setdefault(freeze(k), []).append((segs, node))
+                return
+            if isinstance(node, dict):
+                for key2, child in node.items():
+                    rec(child, segs + (key2,))
+
+        if isinstance(tree, dict):
+            rec(tree, ())
+        self._prune_indexes[ikey] = (self._data_gen, index)
+        return index
+
+    def _render_pruned(
+        self,
+        target: str,
+        constraint: Dict[str, Any],
+        review: Any,
+        plan: Dict[str, Any],
+        trace: Optional[List[str]],
+        frozen_review: Any,
+    ) -> List[Result]:
+        """Interpreter render against a PRUNED inventory: only the
+        derived-key index's candidates for this review's key. Sound
+        because the compile proved the violating clause implies
+        F(candidate) == F(review side) and no other clause touches the
+        inventory — candidates are the only objects that can appear in
+        any violation."""
+        kind = constraint.get("kind")
+        params = M.constraint_parameters(constraint)
+        cur: Any = review
+        for seg in plan["review_prefix"]:
+            if not isinstance(cur, dict) or seg not in cur:
+                cur = None
+                break
+            cur = cur[seg]
+        candidates: List[Tuple[Tuple[str, ...], Any]] = []
+        if cur is not None:
+            oracle = self._prune_oracle(target, kind, params)
+            k, defined = oracle(plan["fn"], cur)
+            if defined:
+                index = self._prune_index(target, kind, params, plan)
+                candidates = index.get(freeze(k), [])
+        pruned_tree: Dict[str, Any] = {}
+        for segs, obj in candidates:
+            node = pruned_tree
+            for seg in segs[:-1]:
+                node = node.setdefault(seg, {})
+            node[segs[-1]] = obj
+        pruned_inv = freeze({plan["tree"]: pruned_tree})
+        return self._eval_template(
+            target, constraint, review, pruned_inv, trace,
+            frozen_review=frozen_review,
+        )
 
     # -- compiled message rendering ------------------------------------------
 

@@ -41,6 +41,10 @@ class Program:
     # row-level safety flags; flagged rows render via the interpreter
     branches: Optional[Tuple] = None
     flags: Tuple = ()
+    # derived-key join prune plan ({fn, review_prefix, tree}): flagged
+    # pairs render against the key index's candidate objects instead of
+    # the whole inventory (uniqueserviceselector at 100k scale)
+    prune: Optional[Dict[str, Any]] = None
 
 
 def compile_program(
@@ -87,6 +91,7 @@ def compile_program(
         # genuine opacity (dropped conditions) disables them entirely
         branches=tuple(comp.out_branches) if not comp.opaque else None,
         flags=tuple(comp.out_flags),
+        prune=comp.prune_plan,
     )
 
 

@@ -190,6 +190,11 @@ class SInventory(SVal):
 
     path: Optional[Tuple[str, ...]] = None
     root: int = -1
+    # derived-value provenance for the render-prune detection
+    # (uniqueserviceselector's flatten_selector idiom):
+    # ("rev", fn, review_prefix) for F(<review subdocument>),
+    # ("inv", fn, walk_path) for F(<inventory-walked object>)
+    call_tag: Any = None
 
 
 @dataclass
@@ -595,6 +600,15 @@ class Compiler:
         # flags (a flagged row renders via the interpreter)
         self.out_branches: List[Any] = []
         self.out_flags: List[Expr] = []
+        # render-prune detection (derived-key inventory joins): per-
+        # clause records + per-clause inventory-root usage; assembled
+        # into prune_plan when exactly one clause touches inventory and
+        # its every deref is the one recorded join
+        self._clause_prunes: List[Tuple[str, Tuple[str, ...], str]] = []
+        self._prune_records: List[Tuple[int, Tuple]] = []
+        self._clause_inv_roots: List[Tuple[int, int]] = []
+        self._clause_n = 0
+        self.prune_plan: Optional[Dict[str, Any]] = None
 
     def _pattern(self, segs: Tuple[str, ...]) -> int:
         idx = self.patterns.register(segs)
@@ -656,7 +670,36 @@ class Compiler:
         total = counts[0]
         for c in counts[1:]:
             total = e_arith("+", total, c)
+        self._assemble_prune_plan()
         return total
+
+    def _assemble_prune_plan(self) -> None:
+        """Valid iff exactly one clause touches the inventory, it walked
+        exactly one root, and that root's sole use is the recorded
+        derived-key join — then every object the clause can match is in
+        the join key's candidate set, so the render may prune."""
+        if not self._prune_records:
+            return
+        inv_clauses = [c for c, n in self._clause_inv_roots if n > 0]
+        if len(inv_clauses) != 1:
+            return
+        clause = inv_clauses[0]
+        recs = {r for c, r in self._prune_records if c == clause}
+        if len(recs) != 1 or any(
+            c != clause for c, _ in self._prune_records
+        ):
+            return
+        root_count = next(
+            n for c, n in self._clause_inv_roots if c == clause
+        )
+        if root_count != 1:
+            return
+        fn, prefix, tree = next(iter(recs))
+        self.prune_plan = {
+            "fn": fn,
+            "review_prefix": prefix,
+            "tree": tree,
+        }
 
     def _compile_clause(
         self, rule: A.Rule
@@ -664,7 +707,17 @@ class Compiler:
         flags_base = len(self._force_flags)
         joins_base = len(self._clause_joins)
         guards_base = len(self._clause_guards)
+        prunes_base = len(self._clause_prunes)
+        roots_base = self._inv_root_n
+        self._clause_n += 1
+        clause_idx = self._clause_n
         finals = self._eval_body(rule.body, State(env={}))
+        for rec in self._clause_prunes[prunes_base:]:
+            self._prune_records.append((clause_idx, rec))
+        del self._clause_prunes[prunes_base:]
+        self._clause_inv_roots.append(
+            (clause_idx, self._inv_root_n - roots_base)
+        )
         # safety flags raised during this clause's evaluation OR into
         # every branch: flagged rows always route to the interpreter
         clause_flags = self._force_flags[flags_base:]
@@ -1732,21 +1785,50 @@ class Compiler:
         return out
 
     def _apply_call(self, name: str, args: List[SVal], st: State):
+        # derived-value provenance for render pruning: an opaque result
+        # of a pure 1-arg template helper remembers WHOSE value it is —
+        # F(<review subdoc>) or F(<inventory-walked object>). Applied to
+        # every opaque outcome (the inline may "succeed" opaquely when
+        # its comprehension screens out, or abort outright).
+        base = name.split(".")[-1] if "." in name else name
+        tag = None
+        if len(args) == 1 and base in self.rules:
+            if isinstance(args[0], SNode):
+                tag = ("rev", base, args[0].prefix)
+            elif (
+                isinstance(args[0], SInventory)
+                and args[0].path is not None
+            ):
+                tag = ("inv", base, args[0].path)
+
+        def tagged(outs):
+            if tag is None:
+                return outs
+            return [
+                (
+                    replace(v, call_tag=tag)
+                    if isinstance(v, SInventory) and v.call_tag is None
+                    else v,
+                    s,
+                )
+                for v, s in outs
+            ]
+
         if any(isinstance(a, SInventory) for a in args):
             # calls over inventory values (identical(), flatten_selector,
             # re_match on an iterated apiversion, sprintf into the msg)
             # produce opaque values; conditions on them drop later
-            return [(SInventory(), st)]
+            return tagged([(SInventory(), st)])
         if self.screen_mode:
             try:
-                return self._apply_call_inner(name, args, st)
+                return tagged(self._apply_call_inner(name, args, st))
             except (CompileUnsupported, InventoryDependent):
                 # InventoryDependent escaping a function body (via the
                 # _inv_barrier) means the call's value depends on
                 # inventory content: opaque, conditions on it drop
                 self.uses_inventory = True
                 self.opaque = True
-                return [(SInventory(), st)]
+                return tagged([(SInventory(), st)])
         return self._apply_call_inner(name, args, st)
 
     def _apply_call_inner(self, name: str, args: List[SVal], st: State):
@@ -2075,6 +2157,36 @@ class Compiler:
             # clause would wrongly screen forks that can violate without
             # the join (those constructs run under the _inv_barrier).
             if op == "==" and self._no_inv_catch == 0:
+                # derived-key join (flatten_selector idiom): BOTH sides
+                # opaque results of the same pure helper F, one over a
+                # review subdocument, one over a full-tree inventory
+                # walk. The clause then implies F(other) == F(review
+                # side), so the interpreter render may soundly restrict
+                # the inventory to a host-built F-key index's candidates
+                # (VERDICT r3 #4: uniqueserviceselector at scale).
+                if (
+                    isinstance(lv, SInventory)
+                    and isinstance(rv, SInventory)
+                ):
+                    tags = {}
+                    for t in (lv.call_tag, rv.call_tag):
+                        if t is not None:
+                            tags[t[0]] = t
+                    if (
+                        len(tags) == 2
+                        and tags["rev"][1] == tags["inv"][1]
+                        and self._fn_is_pure(tags["rev"][1], set())
+                    ):
+                        walk = tags["inv"][2]
+                        tree = walk[0] if walk else None
+                        depth_ok = (
+                            tree == "namespace" and len(walk) == 5
+                            or tree == "cluster" and len(walk) == 4
+                        ) and all(s == "?" for s in walk[1:])
+                        if depth_ok:
+                            self._clause_prunes.append(
+                                (tags["rev"][1], tags["rev"][2], tree)
+                            )
                 inv = lv if isinstance(lv, SInventory) else rv
                 other = rv if isinstance(lv, SInventory) else lv
                 try:
