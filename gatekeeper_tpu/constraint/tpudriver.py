@@ -206,6 +206,7 @@ class TpuDriver(RegoDriver):
         # joins): key index per data generation + oracle contexts
         self._prune_indexes: Dict[Tuple, Tuple[int, Any]] = {}
         self._prune_oracles: Dict[Tuple, Any] = {}
+        self._hot_redispatches = 0  # chunks rerun for compaction overflow
 
     # -- module/data bookkeeping (cache invalidation) ------------------------
 
@@ -720,6 +721,7 @@ class TpuDriver(RegoDriver):
         rerun's own n_hot fits, so no hot row is ever truncated."""
         from ..parallel.sharding import StagedBatch
 
+        self._hot_redispatches += 1
         r_cap = 1 << (n_hot - 1).bit_length()
         batch = StagedBatch(
             fb_dev={k: v[ci] for k, v in stacked.fb_dev.items()},
@@ -977,6 +979,7 @@ class TpuDriver(RegoDriver):
                 "interp_rendered_pairs": n_interp_render,
                 "pruned_renders": n_pruned,
                 "render_errors": self._render_errors,
+                "hot_redispatches": self._hot_redispatches,
             }
             if trace is not None:
                 trace.append(

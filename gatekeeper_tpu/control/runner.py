@@ -288,6 +288,7 @@ class Runner:
                 cluster=self.cluster,
                 excluder=self.excluder,
                 logger=self.log,
+                wait_for=self._wait_ingested,
             )
             self.audit.start()
 
@@ -303,7 +304,7 @@ class Runner:
         if self.readyz_port is not None:
             self._serve_readyz()
 
-    def wait_ready(self, timeout: float = 30.0) -> bool:
+    def _wait_ingested(self, timeout: float = 30.0) -> bool:
         """Block until ingestion satisfies the readiness barrier."""
         import time
 
@@ -314,6 +315,23 @@ class Runner:
                 return True
             time.sleep(0.01)
         return self.tracker.satisfied()
+
+    def wait_ready(self, timeout: float = 30.0) -> bool:
+        """Readiness = ingestion barrier satisfied AND (when this pod
+        runs audit) the warmup sweep done, so the first sweep a client
+        observes after Ready is a warm one (VERDICT r3 #7: the compile
+        cliff must sit BEFORE Ready, not after)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        if not self._wait_ingested(timeout):
+            return False
+        if self.audit is not None:
+            if not self.audit.warmed.wait(
+                max(0.0, deadline - time.monotonic())
+            ):
+                return False
+        return True
 
     def stop(self) -> None:
         self.switch.stop()
@@ -370,9 +388,26 @@ class Runner:
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
                 if self.path == "/readyz":
-                    ok = runner.tracker.satisfied()
+                    ingested = runner.tracker.satisfied()
+                    audit_warm = (
+                        runner.audit is None
+                        or runner.audit.warmed.is_set()
+                    )
+                    ok = ingested and audit_warm
+                    stats = {
+                        "ingested": ingested,
+                        **runner.tracker.stats(),
+                    }
+                    if runner.audit is not None:
+                        stats["audit"] = {
+                            "warm": runner.audit.warmed.is_set(),
+                            "last_sweep_seconds": (
+                                runner.audit.audit_duration_seconds
+                            ),
+                            "errors": runner.audit.error_count,
+                        }
                     payload = json.dumps(
-                        {"ready": ok, "stats": runner.tracker.stats()}
+                        {"ready": ok, "stats": stats}
                     ).encode()
                     self.send_response(200 if ok else 503)
                 elif self.path == "/healthz":

@@ -260,6 +260,23 @@ def test_readyz_503_before_ready():
 # churn
 
 
+def test_ready_implies_audit_warm(booted):
+    """VERDICT r3 #7: readiness includes audit warmth — once wait_ready
+    returns, the warmup sweep has ALREADY run (kernels compiled, corpus
+    staged), and /readyz exposes the warmth + last sweep duration."""
+    cluster, runner = booted
+    assert runner.audit is not None
+    assert runner.audit.warmed.is_set()
+    assert runner.audit.audit_duration_seconds is not None
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{runner.readyz_port}/readyz"
+    ) as resp:
+        body = json.loads(resp.read())
+    assert body["ready"] is True
+    assert body["stats"]["audit"]["warm"] is True
+    assert body["stats"]["audit"]["last_sweep_seconds"] is not None
+
+
 def test_template_update_churn(booted):
     cluster, runner = booted
     # tighten the template: now requires both labels via new rego message
