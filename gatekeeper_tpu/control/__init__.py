@@ -19,6 +19,7 @@ from .events import (  # noqa: F401
     GVK,
     MODIFIED,
 )
+from .kubecluster import KubeCluster, KubeError  # noqa: F401
 from .watch import Registrar, WatchManager  # noqa: F401
 from .controllers import (  # noqa: F401
     CONFIG_GVK,

@@ -72,6 +72,10 @@ class Runner:
         enable_profiler: bool = False,
         log_denies: bool = False,
         logger=None,
+        # name of a ValidatingWebhookConfiguration to keep injected with
+        # the rotating CA bundle (certs.go:183,468-515); needs
+        # webhook_tls
+        vwh_name: Optional[str] = None,
     ):
         from ..logs import null_logger
 
@@ -108,6 +112,8 @@ class Runner:
         self.readyz_port = readyz_port
         self.exempt_namespaces = list(exempt_namespaces)
         self.webhook_tls = webhook_tls
+        self.vwh_name = vwh_name
+        self.ca_injector = None
         self.webhook = None
         self.audit = None
         self._readyz_httpd: Optional[ThreadingHTTPServer] = None
@@ -273,6 +279,13 @@ class Runner:
                 logger=self.log.with_values(process="webhook"),
             )
             self.webhook.start()
+            if self.vwh_name and self.webhook.rotator is not None:
+                from ..webhook.certs import CaBundleInjector
+
+                self.ca_injector = CaBundleInjector(
+                    self.cluster, self.webhook.rotator, self.vwh_name
+                )
+                self.ca_injector.start()
 
         if OPERATION_AUDIT in self.operations:
             from ..audit import AuditManager
@@ -335,6 +348,8 @@ class Runner:
 
     def stop(self) -> None:
         self.switch.stop()
+        if self.ca_injector is not None:
+            self.ca_injector.stop()
         if self.audit is not None:
             self.audit.stop()
         if self.webhook is not None:

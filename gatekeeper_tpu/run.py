@@ -1,0 +1,131 @@
+"""Process entry point: `python -m gatekeeper_tpu.run` — the main.go
+analog (reference main.go:80-308).
+
+Builds the in-cluster KubeCluster EventSource (service-account config),
+the TPU driver behind the constraint-framework Client, and the Runner
+with the selected operations; flags mirror the reference's surface:
+
+  --operation            webhook|audit|status (repeatable; default all)
+  --port                 webhook HTTPS port (policy.go:73)
+  --health-addr-port     readyz/healthz port (main.go:87)
+  --audit-interval       seconds between sweeps (audit/manager.go:48)
+  --audit-from-cache     sweep the synced cache instead of listing
+  --constraint-violations-limit  per-constraint cap (manager.go:49)
+  --log-denies           structured deny logs (policy.go:73)
+  --emit-admission-events / --emit-audit-events
+  --exempt-namespace     ns-label webhook exemption (repeatable)
+  --cert-dir             TLS artifacts dir (rotated self-signed pair)
+  --vwh-name             ValidatingWebhookConfiguration to keep
+                         injected with the rotating CA bundle
+  --enable-pprof         JAX profiler endpoint on the health server
+  --kube-url/--kube-token/--kube-ca  out-of-cluster apiserver access
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="gatekeeper-tpu")
+    p.add_argument("--operation", action="append", default=None,
+                   choices=["webhook", "audit", "status"])
+    p.add_argument("--port", type=int, default=8443)
+    p.add_argument("--health-addr-port", type=int, default=9090)
+    p.add_argument("--audit-interval", type=float, default=60.0)
+    p.add_argument("--audit-from-cache", action="store_true")
+    p.add_argument("--constraint-violations-limit", type=int, default=20)
+    p.add_argument("--log-denies", action="store_true")
+    p.add_argument("--emit-admission-events", action="store_true")
+    p.add_argument("--emit-audit-events", action="store_true")
+    p.add_argument("--exempt-namespace", action="append", default=[])
+    p.add_argument("--cert-dir", default="/certs")
+    p.add_argument("--vwh-name", default="")
+    p.add_argument("--enable-pprof", action="store_true")
+    p.add_argument("--kube-url", default=None)
+    p.add_argument("--kube-token", default=None)
+    p.add_argument("--kube-ca", default=None)
+    p.add_argument("--kube-insecure", action="store_true")
+    p.add_argument("--pod-name", default=None)
+    return p
+
+
+def build_runner(args, log=None, webhook_tls: bool = True):
+    """(cluster, runner) from parsed flags — factored out of main so
+    tests can drive the REAL entry wiring against a mock apiserver."""
+    import os
+
+    from .constraint import Backend, K8sValidationTarget, TpuDriver
+    from .control import KubeCluster, Runner
+    from .logs import StructuredLogger
+
+    if log is None:
+        log = StructuredLogger()
+    cluster = KubeCluster(
+        base_url=args.kube_url,
+        token=args.kube_token,
+        ca_file=args.kube_ca,
+        verify=not args.kube_insecure,
+        logger=log,
+    )
+    client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    operations = tuple(args.operation) if args.operation else (
+        "webhook", "audit", "status"
+    )
+    runner = Runner(
+        cluster,
+        client,
+        "admission.k8s.gatekeeper.sh",
+        operations=operations,
+        pod_name=args.pod_name
+        or os.environ.get("POD_NAME", "gatekeeper-tpu"),
+        audit_interval=args.audit_interval,
+        webhook_port=args.port,
+        readyz_port=args.health_addr_port,
+        exempt_namespaces=args.exempt_namespace,
+        webhook_tls=webhook_tls,
+        emit_admission_events=args.emit_admission_events,
+        emit_audit_events=args.emit_audit_events,
+        audit_from_cache=args.audit_from_cache,
+        enable_profiler=args.enable_pprof,
+        log_denies=args.log_denies,
+        logger=log,
+        vwh_name=args.vwh_name or None,
+    )
+    return cluster, runner
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from .logs import StructuredLogger
+
+    log = StructuredLogger()
+    cluster, runner = build_runner(args, log=log)
+    log.info(
+        "starting gatekeeper-tpu",
+        operations=args.operation or ["webhook", "audit", "status"],
+        webhook_port=args.port,
+        health_port=args.health_addr_port,
+    )
+    runner.start()
+
+    stop = threading.Event()
+
+    def _sig(signum, frame):
+        log.info("signal received, draining", signum=signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    stop.wait()
+    runner.stop()
+    cluster.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

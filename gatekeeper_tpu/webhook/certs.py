@@ -197,3 +197,76 @@ class CertRotator:
             )
         os.chmod(self.key_path, 0o600)
         self.rotations += 1
+
+
+VWH_GVK_ARGS = ("admissionregistration.k8s.io", "v1",
+                "ValidatingWebhookConfiguration")
+
+
+class CaBundleInjector:
+    """Injects the rotator's CA bundle into a
+    ValidatingWebhookConfiguration and re-injects on drift — the
+    reference's injectCertToWebhook + ReconcileVWH self-healing loop
+    (certs.go:183-263,468-515), driven through the EventSource seam so
+    it works against the FakeCluster and the real apiserver alike."""
+
+    def __init__(self, cluster, rotator: "CertRotator", vwh_name: str):
+        from ..control.events import GVK
+
+        self.cluster = cluster
+        self.rotator = rotator
+        self.vwh_name = vwh_name
+        self.gvk = GVK(*VWH_GVK_ARGS)
+        self.injections = 0
+        self._unsubscribe = None
+
+    def start(self) -> None:
+        self.inject()
+        self._unsubscribe = self.cluster.subscribe(self.gvk, self._on_event)
+
+    def stop(self) -> None:
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None
+
+    def _bundle_b64(self) -> str:
+        import base64
+
+        return base64.b64encode(self.rotator.ca_bundle()).decode()
+
+    def _on_event(self, ev) -> None:
+        meta = ev.obj.get("metadata") or {}
+        if meta.get("name") != self.vwh_name or ev.type == "DELETED":
+            return
+        want = self._bundle_b64()
+        hooks = ev.obj.get("webhooks") or []
+        if any(
+            (h.get("clientConfig") or {}).get("caBundle") != want
+            for h in hooks
+        ):
+            self.inject()
+
+    def inject(self) -> bool:
+        obj = None
+        getter = getattr(self.cluster, "get", None)
+        if getter is not None:
+            obj = getter(self.gvk, "", self.vwh_name)
+        if obj is None:
+            for cand in self.cluster.list(self.gvk):
+                if (cand.get("metadata") or {}).get("name") == self.vwh_name:
+                    obj = cand
+                    break
+        if obj is None:
+            return False
+        want = self._bundle_b64()
+        changed = False
+        hooks = obj.get("webhooks") or []
+        for h in hooks:
+            cc = h.setdefault("clientConfig", {})
+            if cc.get("caBundle") != want:
+                cc["caBundle"] = want
+                changed = True
+        if changed:
+            self.cluster.apply(obj)
+            self.injections += 1
+        return changed

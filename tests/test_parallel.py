@@ -71,9 +71,11 @@ def test_entry_compiles():
     fn, args = ge.entry()
     out = jax.jit(fn)(*args)
     match, counts, totals = out
-    # 3 constraints (labels, privileged, unique-host screen) over
-    # 16 pods + 6 gateways, padded to the 32-row bucket? no — rows
+    # 4 constraints (labels, privileged, unique-host screen, and the
+    # uncompilable deep-scan fallback) over 16 pods + 6 gateways; rows
     # follow the corpus bucket (22 = 16 pods + 6 gateways)
-    assert match.shape == (3, 22)
+    assert match.shape == (4, 22)
+    # counts cover only COMPILED programs: the deep-scan fallback
+    # template's program is None (interpreter-routed)
     assert counts.shape == (3, 22)
-    assert totals.shape == (3,)
+    assert totals.shape == (4,)
