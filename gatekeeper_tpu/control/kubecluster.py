@@ -150,8 +150,13 @@ class KubeCluster(EventSource):
         except KubeError as e:
             if e.code not in (403, 404):
                 raise
-        with self._lock:
-            self._rest_info[gvk] = info
+        # cache POSITIVE results only: a constraint kind's CRD may be
+        # established moments after the template ingests, and a cached
+        # None would make the watcher's retry loop re-read a stale miss
+        # forever (the kind would silently never be enforced)
+        if info is not None:
+            with self._lock:
+                self._rest_info[gvk] = info
         return info
 
     def known_gvks(self) -> List[GVK]:

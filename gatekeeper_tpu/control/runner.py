@@ -76,6 +76,11 @@ class Runner:
         # the rotating CA bundle (certs.go:183,468-515); needs
         # webhook_tls
         vwh_name: Optional[str] = None,
+        # TLS artifact dir (the reference's mounted cert Secret); None =
+        # per-process temp dir
+        cert_dir: Optional[str] = None,
+        # serving bind address: loopback for tests, "0.0.0.0" in-cluster
+        bind_addr: str = "127.0.0.1",
     ):
         from ..logs import null_logger
 
@@ -113,6 +118,8 @@ class Runner:
         self.exempt_namespaces = list(exempt_namespaces)
         self.webhook_tls = webhook_tls
         self.vwh_name = vwh_name
+        self.cert_dir = cert_dir
+        self.bind_addr = bind_addr
         self.ca_injector = None
         self.webhook = None
         self.audit = None
@@ -277,6 +284,8 @@ class Runner:
                 emit_admission_events=self.emit_admission_events,
                 log_denies=self.log_denies,
                 logger=self.log.with_values(process="webhook"),
+                cert_dir=self.cert_dir,
+                bind_addr=self.bind_addr,
             )
             self.webhook.start()
             if self.vwh_name and self.webhook.rotator is not None:
@@ -446,7 +455,7 @@ class Runner:
                 pass
 
         self._readyz_httpd = ThreadingHTTPServer(
-            ("127.0.0.1", self.readyz_port or 0), _Handler
+            (self.bind_addr, self.readyz_port or 0), _Handler
         )
         self.readyz_port = self._readyz_httpd.server_address[1]
         threading.Thread(

@@ -35,7 +35,7 @@ RESOURCE_API_VERSION = "resource_api_version"
 RESOURCE_NAMESPACE = "resource_namespace"
 RESOURCE_NAME = "resource_name"
 
-_LEVELS = {"debug": 10, "info": 20, "error": 40}
+_LEVELS = {"debug": 10, "info": 20, "error": 40, "off": 99}
 
 
 class StructuredLogger:
@@ -105,14 +105,18 @@ class StructuredLogger:
         self._emit("error", msg, kv)
 
 
-_null = StructuredLogger(stream=type("Null", (), {
-    "write": staticmethod(lambda s: None)
-})())
+# level "off" short-circuits _emit BEFORE record construction: the
+# audit path logs per violation, and a sweep with tens of thousands of
+# violations must not pay json.dumps into a void when nothing is wired
+_null = StructuredLogger(
+    stream=type("Null", (), {"write": staticmethod(lambda s: None)})(),
+    level="off",
+)
 
 
 def null_logger() -> StructuredLogger:
-    """A logger that writes nowhere (default for components whose
-    caller did not wire logging)."""
+    """A logger that emits nothing (default for components whose caller
+    did not wire logging); record construction is skipped entirely."""
     return _null
 
 

@@ -94,6 +94,9 @@ def build_runner(args, log=None, webhook_tls: bool = True):
         log_denies=args.log_denies,
         logger=log,
         vwh_name=args.vwh_name or None,
+        cert_dir=args.cert_dir,
+        bind_addr="0.0.0.0",  # kubelet probes and the apiserver dial
+        # the pod IP, not loopback
     )
     return cluster, runner
 

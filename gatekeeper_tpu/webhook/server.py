@@ -240,6 +240,10 @@ class WebhookServer:
         emit_admission_events: bool = False,
         log_denies: bool = False,
         logger=None,
+        # "127.0.0.1" keeps tests hermetic; in-cluster serving must bind
+        # the pod IP surface ("0.0.0.0" via run.py) or the apiserver and
+        # kubelet probes can never connect
+        bind_addr: str = "127.0.0.1",
     ):
         self.batcher = MicroBatcher(
             client, target, window_ms=window_ms,
@@ -294,7 +298,7 @@ class WebhookServer:
             def log_message(self, *args):  # silence default stderr spam
                 pass
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd = ThreadingHTTPServer((bind_addr, port), _Handler)
         self.rotator = None
         if tls:
             import ssl

@@ -118,6 +118,12 @@ class MockApiServer:
             target=self.httpd.serve_forever, daemon=True
         ).start()
 
+    def register(self, gvk, plural, namespaced):
+        """Late CRD establishment (a constraint kind's CRD appearing
+        after the template ingests)."""
+        self._by_path[(gvk.group, gvk.version, plural)] = (gvk, namespaced)
+        self._groups.setdefault(gvk.group, set()).add(gvk.version)
+
     @property
     def url(self):
         return f"http://127.0.0.1:{self.port}"
@@ -588,3 +594,33 @@ def test_run_entrypoint_wiring(mock):
     finally:
         runner.stop()
         cluster.stop()
+
+
+def test_late_crd_establishment_is_rediscovered(mock):
+    """A kind whose CRD is served only AFTER the first subscription
+    attempt must still start watching (negative discovery results are
+    not cached; the watcher's resync retries rediscover it)."""
+    late = GVK("constraints.gatekeeper.sh", "v1beta1", "K8sLateKind")
+    kc = KubeCluster(base_url=mock.url, watch_timeout_seconds=5)
+    got = []
+    unsub = kc.subscribe(late, lambda ev: got.append(ev))
+    try:
+        time.sleep(0.6)  # a few failed resyncs against the unserved kind
+        assert kc.list(late) == []
+        mock.register(late, "k8slatekinds", False)
+        mock.seed(
+            {
+                "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": "K8sLateKind",
+                "metadata": {"name": "c1"},
+                "spec": {},
+            }
+        )
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not got:
+            time.sleep(0.1)
+        assert got and got[0].type == ADDED
+        assert (got[0].obj.get("metadata") or {}).get("name") == "c1"
+    finally:
+        unsub()
+        kc.stop()
