@@ -1,0 +1,279 @@
+// Admission serving bridge, native front half.
+//
+// The reference's webhook is a Go HTTP server (goroutine per request,
+// pkg/webhook/policy.go:141); SURVEY §2.4 row 3 / §7 step 5 reserve a
+// native front for this framework: a C++ process that terminates the
+// admission HTTP traffic on a thread pool (no Python GIL on the accept
+// path) and streams each AdmissionReview body over a Unix socket to the
+// Python/JAX batch server (webhook/bridge.py), which micro-batches into
+// the fused device dispatch.
+//
+// Protocol (frontend <-> backend): length-prefixed frames over one Unix
+// socket per in-flight request — [u32 big-endian length][payload]. The
+// request payload is the raw AdmissionReview JSON body; the response
+// payload is the complete AdmissionReview response JSON.
+//
+// Failure semantics mirror the reference's fail-open posture
+// (failurePolicy: Ignore, policy.go:80): a backend that is down or
+// misses --deadline-ms gets an allow-with-warning response so admission
+// never wedges the cluster; the audit sweep remains the backstop.
+//
+// Build: g++ -O2 -pthread -o bridge_frontend bridge_frontend.cpp
+// Run:   bridge_frontend --port 0 --backend /tmp/gk.sock \
+//          [--deadline-ms 2000] [--threads 64]
+// Prints "LISTENING <port>" on stdout once bound.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Config {
+  int port = 0;
+  std::string backend;
+  int deadline_ms = 2000;
+  int threads = 64;  // accept backlog workers (thread per connection)
+};
+
+std::atomic<bool> g_stop{false};
+
+ssize_t read_full(int fd, void* buf, size_t n, int timeout_ms) {
+  size_t got = 0;
+  auto* p = static_cast<char*>(buf);
+  while (got < n) {
+    struct pollfd pfd{fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, timeout_ms);
+    if (pr <= 0) return -1;  // timeout or error
+    ssize_t r = read(fd, p + got, n - got);
+    if (r <= 0) return -1;
+    got += static_cast<size_t>(r);
+  }
+  return static_cast<ssize_t>(got);
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  size_t sent = 0;
+  const char* p = static_cast<const char*>(buf);
+  while (sent < n) {
+    ssize_t w = write(fd, p + sent, n - sent);
+    if (w <= 0) return false;
+    sent += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+// One round trip to the Python batch server; empty string = failure.
+std::string backend_call(const Config& cfg, const std::string& body) {
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return "";
+  struct sockaddr_un addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, cfg.backend.c_str(),
+               sizeof(addr.sun_path) - 1);
+  if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    close(fd);
+    return "";
+  }
+  uint32_t len = htonl(static_cast<uint32_t>(body.size()));
+  if (!write_full(fd, &len, 4) ||
+      !write_full(fd, body.data(), body.size())) {
+    close(fd);
+    return "";
+  }
+  uint32_t rlen_be = 0;
+  if (read_full(fd, &rlen_be, 4, cfg.deadline_ms) != 4) {
+    close(fd);
+    return "";
+  }
+  uint32_t rlen = ntohl(rlen_be);
+  if (rlen > (64u << 20)) {  // 64MB sanity cap
+    close(fd);
+    return "";
+  }
+  std::string out(rlen, '\0');
+  if (read_full(fd, out.data(), rlen, cfg.deadline_ms) !=
+      static_cast<ssize_t>(rlen)) {
+    close(fd);
+    return "";
+  }
+  close(fd);
+  return out;
+}
+
+// Fail-open AdmissionReview response (uid copied from the request when
+// findable; the apiserver tolerates an empty uid on failurePolicy
+// retries, but we extract it for correctness).
+std::string fail_open_response(const std::string& body) {
+  // minimal uid extraction: find "uid":"..." inside "request"
+  std::string uid;
+  size_t req = body.find("\"request\"");
+  if (req != std::string::npos) {
+    size_t u = body.find("\"uid\"", req);
+    if (u != std::string::npos) {
+      size_t q1 = body.find('"', u + 5);  // value's opening quote
+      if (q1 != std::string::npos) {
+        size_t q2 = body.find('"', q1 + 1);  // value's closing quote
+        if (q2 != std::string::npos) uid = body.substr(q1 + 1, q2 - q1 - 1);
+      }
+    }
+  }
+  std::string resp =
+      "{\"apiVersion\":\"admission.k8s.io/v1\",\"kind\":\"AdmissionReview\","
+      "\"response\":{\"uid\":\"" + uid + "\",\"allowed\":true,"
+      "\"warnings\":[\"gatekeeper-tpu backend unavailable or over "
+      "deadline; failing open (audit is the backstop)\"]}}";
+  return resp;
+}
+
+void respond(int fd, int code, const std::string& reason,
+             const std::string& body, bool keep_alive) {
+  std::string head = "HTTP/1.1 " + std::to_string(code) + " " + reason +
+                     "\r\nContent-Type: application/json\r\n"
+                     "Content-Length: " + std::to_string(body.size()) +
+                     "\r\nConnection: " +
+                     (keep_alive ? "keep-alive" : "close") + "\r\n\r\n";
+  write_full(fd, head.data(), head.size());
+  write_full(fd, body.data(), body.size());
+}
+
+// Reads one HTTP request; returns false to close the connection.
+bool handle_one(const Config& cfg, int fd) {
+  // read until end of headers
+  std::string buf;
+  char tmp[4096];
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    struct pollfd pfd{fd, POLLIN, 0};
+    // generous idle keep-alive window
+    int pr = poll(&pfd, 1, 30000);
+    if (pr <= 0) return false;
+    ssize_t r = read(fd, tmp, sizeof(tmp));
+    if (r <= 0) return false;
+    buf.append(tmp, static_cast<size_t>(r));
+    header_end = buf.find("\r\n\r\n");
+    if (buf.size() > (1u << 20) && header_end == std::string::npos)
+      return false;  // oversized headers
+  }
+  std::string headers = buf.substr(0, header_end);
+  std::string body = buf.substr(header_end + 4);
+
+  // request line
+  size_t sp1 = headers.find(' ');
+  size_t sp2 = headers.find(' ', sp1 + 1);
+  if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
+  std::string method = headers.substr(0, sp1);
+  std::string path = headers.substr(sp1 + 1, sp2 - sp1 - 1);
+
+  // content-length (case-insensitive scan)
+  size_t content_length = 0;
+  {
+    std::string lower = headers;
+    for (auto& ch : lower) ch = static_cast<char>(tolower(ch));
+    size_t cl = lower.find("content-length:");
+    if (cl != std::string::npos)
+      content_length = std::strtoul(lower.c_str() + cl + 15, nullptr, 10);
+    if (content_length > (64u << 20)) return false;
+  }
+  while (body.size() < content_length) {
+    struct pollfd pfd{fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, 10000);
+    if (pr <= 0) return false;
+    ssize_t r = read(fd, tmp, sizeof(tmp));
+    if (r <= 0) return false;
+    body.append(tmp, static_cast<size_t>(r));
+  }
+  body.resize(content_length);
+
+  if (path == "/healthz") {
+    respond(fd, 200, "OK", "{\"ok\":true}", true);
+    return true;
+  }
+  if (method != "POST" ||
+      (path != "/v1/admit" && path != "/v1/admitlabel")) {
+    respond(fd, 404, "Not Found", "{\"error\":\"not found\"}", true);
+    return true;
+  }
+  std::string out = backend_call(cfg, body);
+  if (out.empty()) out = fail_open_response(body);
+  respond(fd, 200, "OK", out, true);
+  return true;
+}
+
+void serve_conn(const Config& cfg, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  while (!g_stop.load() && handle_one(cfg, fd)) {
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Config cfg;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&](int& i) -> const char* {
+      return (i + 1 < argc) ? argv[++i] : "";
+    };
+    if (a == "--port") cfg.port = std::atoi(next(i));
+    else if (a == "--backend") cfg.backend = next(i);
+    else if (a == "--deadline-ms") cfg.deadline_ms = std::atoi(next(i));
+    else if (a == "--threads") cfg.threads = std::atoi(next(i));
+  }
+  if (cfg.backend.empty()) {
+    std::fprintf(stderr, "--backend <unix socket path> is required\n");
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(cfg.port));
+  if (bind(lfd, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) != 0 ||
+      listen(lfd, 1024) != 0) {
+    std::perror("bind/listen");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(lfd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+  std::printf("LISTENING %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  while (!g_stop.load()) {
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    // thread per keep-alive connection: the apiserver maintains a
+    // modest pool of long-lived connections, far below thread limits
+    std::thread(serve_conn, std::cref(cfg), cfd).detach();
+  }
+  close(lfd);
+  return 0;
+}

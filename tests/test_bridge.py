@@ -1,0 +1,210 @@
+"""Native serving bridge e2e: C++ frontend <-> Python batch backend.
+
+SURVEY §2.4 row 3 / §7 step 5 (the reference's goroutine-per-request
+webhook, policy.go:141, re-architected as a native thread-pool front +
+micro-batched JAX back). Pins: end-to-end allow/deny through the real
+compiled binary over HTTP, concurrent requests coalescing into fused
+batches, and the fail-open deadline contract when the backend stalls.
+"""
+
+import json
+import shutil
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget, TpuDriver
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+REQ_LABELS = """package reqlabels
+
+violation[{"msg": msg}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("missing: %v", [missing])
+}
+"""
+
+
+def make_client():
+    client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    client.add_template(
+        {
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "k8srequiredlabels"},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": "K8sRequiredLabels"}}},
+                "targets": [{"target": TARGET, "rego": REQ_LABELS}],
+            },
+        }
+    )
+    client.add_constraint(
+        {
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K8sRequiredLabels",
+            "metadata": {"name": "need-owner"},
+            "spec": {
+                "match": {
+                    "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]
+                },
+                "parameters": {"labels": ["owner"]},
+            },
+        }
+    )
+    return client
+
+
+def review_body(i, labels):
+    return json.dumps(
+        {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": f"uid-{i}",
+                "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "operation": "CREATE",
+                "name": f"p{i}",
+                "namespace": "default",
+                "userInfo": {"username": "t"},
+                "object": {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": f"p{i}",
+                        "namespace": "default",
+                        "labels": labels,
+                    },
+                    "spec": {
+                        "containers": [{"name": "c", "image": "nginx"}]
+                    },
+                },
+            },
+        }
+    ).encode()
+
+
+def post(port, body, path="/v1/admit"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=body,
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_bridge_end_to_end(tmp_path):
+    from gatekeeper_tpu.webhook.bridge import BridgeStack
+
+    stack = BridgeStack(
+        make_client(), TARGET, str(tmp_path / "gk.sock"), deadline_ms=30000
+    )
+    stack.start()
+    try:
+        deny = post(stack.port, review_body(1, {}))
+        assert deny["response"]["allowed"] is False
+        assert "need-owner" in deny["response"]["status"]["message"]
+        assert deny["response"]["uid"] == "uid-1"
+
+        allow = post(stack.port, review_body(2, {"owner": "me"}))
+        assert allow["response"]["allowed"] is True
+
+        # health endpoint answers from the native front directly
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{stack.port}/healthz", timeout=10
+        ) as r:
+            assert json.loads(r.read())["ok"] is True
+
+        # concurrency: many simultaneous requests coalesce into fused
+        # batches behind the bridge
+        stack.batcher.batches_dispatched = 0
+        stack.batcher.requests_batched = 0
+        with ThreadPoolExecutor(max_workers=32) as ex:
+            outs = list(
+                ex.map(
+                    lambda i: post(stack.port, review_body(100 + i, {})),
+                    range(64),
+                )
+            )
+        assert all(o["response"]["allowed"] is False for o in outs)
+        assert stack.backend.requests_served >= 66
+        assert (
+            stack.batcher.requests_batched
+            > stack.batcher.batches_dispatched
+        ), "no batching happened behind the bridge"
+    finally:
+        stack.stop()
+
+
+def test_bridge_fails_open_on_deadline(tmp_path):
+    """A stalled backend must not wedge admission: the native front
+    answers allow-with-warning within its deadline (failurePolicy:
+    Ignore semantics, policy.go:80)."""
+    from gatekeeper_tpu.webhook.bridge import BatchBridgeServer, build_frontend
+    import subprocess
+
+    class StallingHandler:
+        def handle(self, request):
+            time.sleep(5.0)
+            raise AssertionError("unreachable in this test window")
+
+    sock = str(tmp_path / "stall.sock")
+    backend = BatchBridgeServer(StallingHandler(), sock)
+    backend.start()
+    binary = build_frontend()
+    assert binary
+    proc = subprocess.Popen(
+        [binary, "--port", "0", "--backend", sock, "--deadline-ms", "400"],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        t0 = time.monotonic()
+        out = post(port, review_body(7, {}))
+        elapsed = time.monotonic() - t0
+        assert out["response"]["allowed"] is True
+        assert out["response"]["uid"] == "uid-7"
+        assert "failing open" in " ".join(
+            out["response"].get("warnings", [])
+        )
+        assert elapsed < 3.0, f"deadline not enforced ({elapsed:.1f}s)"
+    finally:
+        proc.terminate()
+        backend.stop()
+
+
+def test_bridge_fails_open_when_backend_down(tmp_path):
+    from gatekeeper_tpu.webhook.bridge import build_frontend
+    import subprocess
+
+    binary = build_frontend()
+    assert binary
+    proc = subprocess.Popen(
+        [
+            binary, "--port", "0",
+            "--backend", str(tmp_path / "nonexistent.sock"),
+            "--deadline-ms", "500",
+        ],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        out = post(port, review_body(9, {}))
+        assert out["response"]["allowed"] is True
+        assert out["response"]["uid"] == "uid-9"
+    finally:
+        proc.terminate()
