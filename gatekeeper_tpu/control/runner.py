@@ -129,12 +129,16 @@ class Runner:
         self.trace_config = TraceConfig()
         self.emit_admission_events = emit_admission_events
         self.emit_audit_events = emit_audit_events
-        # K8s Events stand-in: a BOUNDED ring of emitted violation
-        # events (audit re-emits persisting violations every sweep; an
-        # unbounded list would leak for the process lifetime)
+        # emitted violation events: a BOUNDED in-memory ring for
+        # introspection (audit re-emits persisting violations every
+        # sweep; an unbounded list would leak for the process lifetime)
+        # PLUS real v1 Event objects written through the EventSource —
+        # against a live apiserver these are actual cluster Events
+        # (policy.go:253-273 AnnotatedEventf / audit emitEvent)
         from collections import deque
 
         self.events: Any = deque(maxlen=4096)
+        self._event_seq = 0
 
         # controllers (wired, not yet watching)
         self.constraint_controller = ConstraintController(
@@ -280,7 +284,7 @@ class Runner:
                 metrics=self.metrics,
                 tls=self.webhook_tls,
                 trace_config=self.trace_config,
-                event_sink=self.events.append,
+                event_sink=self._emit_event,
                 emit_admission_events=self.emit_admission_events,
                 log_denies=self.log_denies,
                 logger=self.log.with_values(process="webhook"),
@@ -304,7 +308,7 @@ class Runner:
                 self.target,
                 audit_interval=self.audit_interval,
                 metrics=self.metrics,
-                event_sink=self.events.append,
+                event_sink=self._emit_event,
                 emit_audit_events=self.emit_audit_events,
                 audit_from_cache=self.audit_from_cache,
                 cluster=self.cluster,
@@ -325,6 +329,47 @@ class Runner:
 
         if self.readyz_port is not None:
             self._serve_readyz()
+
+    def _emit_event(self, ev: Dict[str, Any]) -> None:
+        """Violation-event sink: the bounded in-memory ring PLUS a real
+        v1 Event written through the EventSource — against a live
+        apiserver these are actual cluster Events (the reference's
+        AnnotatedEventf, policy.go:253-273 / audit emitEvent)."""
+        self.events.append(ev)
+        try:
+            import time as _time
+
+            self._event_seq += 1
+            ts = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+            ns = ev.get("resource_namespace") or "gatekeeper-system"
+            self.cluster.apply(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Event",
+                    "metadata": {
+                        "name": (
+                            f"gatekeeper-tpu.{self._event_seq}."
+                            f"{int(_time.time() * 1000):x}"
+                        ),
+                        "namespace": ns,
+                    },
+                    "type": ev.get("type", "Warning"),
+                    "reason": ev.get("reason", "Violation"),
+                    "message": ev.get("message", ""),
+                    "source": {"component": "gatekeeper-tpu"},
+                    "involvedObject": {
+                        "kind": ev.get("resource_kind", ""),
+                        "namespace": ev.get("resource_namespace", ""),
+                        "name": ev.get("resource_name", ""),
+                    },
+                    "firstTimestamp": ts,
+                    "lastTimestamp": ts,
+                    "count": 1,
+                }
+            )
+        except Exception as e:
+            # Event emission is best-effort in the reference too
+            self.log.debug("event emission failed", err=str(e))
 
     def _wait_ingested(self, timeout: float = 30.0) -> bool:
         """Block until ingestion satisfies the readiness barrier."""

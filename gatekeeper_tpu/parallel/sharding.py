@@ -214,6 +214,27 @@ class FusedAuditKernel:
         s = self._spec(*axes)
         return arr if s is None else jax.device_put(arr, s)
 
+    def _put_group(self, arrays, *axes):
+        """Device-put a dict of host arrays minimizing TRANSFERS:
+        same-(dtype, shape) entries ship as ONE stacked buffer and
+        unstack into device views. Host->device hops dominate the
+        webhook batch staging (each put is a separate latency-bound
+        transfer; a review batch stages ~20 small arrays)."""
+        out = {}
+        groups: Dict[Tuple, List[str]] = {}
+        for k, v in arrays.items():
+            a = np.asarray(v)
+            groups.setdefault((str(a.dtype), a.shape), []).append(k)
+        for (_, _shape), names in groups.items():
+            if len(names) == 1:
+                out[names[0]] = self._put(arrays[names[0]], *axes)
+                continue
+            stacked = np.stack([np.asarray(arrays[n]) for n in names])
+            buf = self._put(stacked, None, *axes)
+            for i, n in enumerate(names):
+                out[n] = buf[i]
+        return out
+
     def _tables_device(self) -> Dict[str, Any]:
         self.patterns.sync()
         self.tables.sync()
@@ -483,18 +504,22 @@ class FusedAuditKernel:
         rows. Per-kind slabs are stacked here in the SAME column order
         as the resident fused tables so one col mapping serves both."""
         k = len(chunks)
-        fb_dev = {
-            key: self._put(
-                np.stack([c[0][key] for c in chunks]), None, "n"
-            )
-            for key in chunks[0][0]
-        }
-        tok_dev = {
-            key: self._put(
-                np.stack([c[1][key] for c in chunks]), None, "n"
-            )
-            for key in chunks[0][1]
-        }
+        fb_dev = self._put_group(
+            {
+                key: np.stack([c[0][key] for c in chunks])
+                for key in chunks[0][0]
+            },
+            None,
+            "n",
+        )
+        tok_dev = self._put_group(
+            {
+                key: np.stack([c[1][key] for c in chunks])
+                for key in chunks[0][1]
+            },
+            None,
+            "n",
+        )
         chunk = tok_dev["spath"].shape[1]
         row_fb = np.zeros((k, chunk), bool)
         for i, c in enumerate(chunks):
@@ -504,9 +529,9 @@ class FusedAuditKernel:
         ov_key: Tuple = ()
         if ov is not None:
             self._tables_device()  # ensure _fused_cols is current
-            ov_dev = {
-                "member": self._put(ov["member"]),
-                "capture": self._put(ov["capture"]),
+            ov_host: Dict[str, np.ndarray] = {
+                "member": np.asarray(ov["member"]),
+                "capture": np.asarray(ov["capture"]),
             }
             b_pad = ov["member"].shape[0]
             tabs = ov.get("tabs") or {}
@@ -520,7 +545,8 @@ class FusedAuditKernel:
                     t = tabs.get(name)
                     if t is not None:
                         slab[:, col] = t.astype(dt)
-                ov_dev[kind] = self._put(slab)
+                ov_host[kind] = slab
+            ov_dev = self._put_group(ov_host)
             ov_key = (b_pad, tuple(sorted(ov_dev)))
         return StackedCorpus(
             fb_dev=fb_dev,

@@ -51,6 +51,7 @@ REGISTRY = [
     (GVK("", "v1", "Pod"), "pods", True),
     (GVK("", "v1", "Namespace"), "namespaces", False),
     (GVK("", "v1", "Service"), "services", True),
+    (GVK("", "v1", "Event"), "events", True),
     (GVK("templates.gatekeeper.sh", "v1beta1", "ConstraintTemplate"),
      "constrainttemplates", False),
     (GVK("templates.gatekeeper.sh", "v1alpha1", "ConstraintTemplate"),
@@ -491,6 +492,7 @@ def test_runner_e2e_against_apiserver(mock):
         readyz_port=0,
         webhook_tls=True,
         vwh_name="gatekeeper-vwh",
+        emit_audit_events=True,
     )
     runner.start()
     try:
@@ -522,6 +524,14 @@ def test_runner_e2e_against_apiserver(mock):
                 "ValidatingWebhookConfiguration")
         )[0]
         assert vwh["webhooks"][0]["clientConfig"].get("caBundle")
+
+        # violation events became REAL v1 Events through the apiserver
+        events = mock.store.list(GVK("", "v1", "Event"))
+        assert events and any(
+            e.get("reason") == "AuditViolation"
+            and (e.get("involvedObject") or {}).get("name") == "bad"
+            for e in events
+        ), events
 
         # live churn: a new violating pod flows watch -> sync -> audit
         mock.seed(pod("bad2"))
