@@ -176,8 +176,9 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
         out = []
         # two violation profiles:
         #  * 100% violating — the reference harness's stress shape
-        #    (every pair needs an exact interpreter message render:
-        #    worst case for the sparse-violation architecture);
+        #    (every violating pair renders; since r4 this is the
+        #    COMPILED message path, engine/render.py, not per-pair
+        #    interpretation);
         #  * 0% violating — the steady-state admission shape where the
         #    fused device screen answers allow without any host render.
         # Lower concurrencies replay subsamples: per-batch round trips
@@ -188,10 +189,6 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
                 make_request(i, violating=violating)
                 for i in range(n_requests)
             ]
-            # the violating high-concurrency point saturates on exact
-            # message rendering (~25 rps on one host core); a smaller
-            # sample measures the same saturated p50/throughput without
-            # spending minutes of bench wall-time on it
             hi_n = max(1500, n_requests // 6) if violating else (
                 max(4000, n_requests // 2)
             )
@@ -210,7 +207,88 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
                 print(f"webhook replay: {r}", file=err)
     finally:
         batcher.stop()
-    return {"cpu_python_interp": cpu, "tpu_batched": out}
+    bridge = run_bridge_bench(n_requests, n_constraints, err=err)
+    return {
+        "cpu_python_interp": cpu,
+        "tpu_batched": out,
+        "tpu_bridge": bridge,
+    }
+
+
+def run_bridge_bench(n_requests, n_constraints, err=sys.stderr):
+    """The native serving stack (C++ front + unix-socket batch backend):
+    full-HTTP replay through the compiled bridge_frontend binary at high
+    concurrency — the no-GIL-on-the-accept-path architecture SURVEY §7
+    step 5 names. Skipped (with a marker) when no C++ toolchain."""
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    from gatekeeper_tpu.constraint import TpuDriver
+    from gatekeeper_tpu.webhook.bridge import BridgeStack, build_frontend
+
+    if build_frontend() is None:
+        return {"skipped": "no C++ toolchain"}
+    client = build_webhook_client(TpuDriver(), n_constraints)
+    sock = tempfile.mktemp(prefix="gk-bridge-", suffix=".sock")
+    stack = BridgeStack(
+        client, TARGET, sock, deadline_ms=60_000, request_timeout=60
+    )
+    stack.start()
+    out = []
+    try:
+        def post(i, violating):
+            body = _json.dumps(
+                {
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": make_request(i, violating=violating),
+                }
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{stack.port}/v1/admit",
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                doc = _json.loads(resp.read())
+            return time.perf_counter() - t0, doc["response"]["allowed"]
+
+        # warm
+        with ThreadPoolExecutor(max_workers=32) as ex:
+            list(ex.map(lambda i: post(i, True), range(128)))
+        for violating in (True, False):
+            n_sub = max(1000, n_requests // 8)
+            lat = np.zeros(n_sub)
+            denied = [0]
+
+            def one(i):
+                dt, allowed = post(i, violating)
+                lat[i] = dt
+                if not allowed:
+                    denied[0] += 1
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=128) as ex:
+                list(ex.map(one, range(n_sub)))
+            wall = time.perf_counter() - t0
+            r = {
+                "concurrency": 128,
+                "requests": n_sub,
+                "violating": violating,
+                "wall_seconds": round(wall, 3),
+                "throughput_rps": round(n_sub / wall, 1),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+                "denied": denied[0],
+            }
+            out.append(r)
+            print(f"bridge replay: {r}", file=err)
+    finally:
+        stack.stop()
+    return out
 
 
 if __name__ == "__main__":
