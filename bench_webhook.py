@@ -169,9 +169,17 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
     handler = BatchedValidationHandler(batcher, request_timeout=60)
     batcher.start()
     try:
-        # warm the jit for the occupancy buckets
+        # warm the jit across the occupancy buckets BOTH concurrency
+        # profiles produce (batch-size buckets differ between c=8 and
+        # c=128; compiles inside the measured replay would skew p99)
         warm = [make_request(i) for i in range(256)]
         replay(handler, warm, 64)
+        replay(handler, [make_request(i) for i in range(512)], 128)
+        replay(
+            handler,
+            [make_request(i, violating=False) for i in range(512)],
+            128,
+        )
 
         out = []
         # two violation profiles:
@@ -256,9 +264,11 @@ def run_bridge_bench(n_requests, n_constraints, err=sys.stderr):
                 doc = _json.loads(resp.read())
             return time.perf_counter() - t0, doc["response"]["allowed"]
 
-        # warm
-        with ThreadPoolExecutor(max_workers=32) as ex:
-            list(ex.map(lambda i: post(i, True), range(128)))
+        # warm the batch-size buckets both profiles produce at full
+        # concurrency (compiles inside the measurement skew p99)
+        for viol in (True, False):
+            with ThreadPoolExecutor(max_workers=128) as ex:
+                list(ex.map(lambda i: post(i, viol), range(512)))
         for violating in (True, False):
             n_sub = max(1000, n_requests // 8)
             lat = np.zeros(n_sub)
