@@ -272,13 +272,13 @@ def run_bridge_bench(n_requests, n_constraints, err=sys.stderr):
         for violating in (True, False):
             n_sub = max(1000, n_requests // 8)
             lat = np.zeros(n_sub)
-            denied = [0]
+            allowed_arr = np.zeros(n_sub, bool)  # per-index: no shared
+            # counter races across the 128 workers
 
             def one(i):
                 dt, allowed = post(i, violating)
                 lat[i] = dt
-                if not allowed:
-                    denied[0] += 1
+                allowed_arr[i] = allowed
 
             t0 = time.perf_counter()
             with ThreadPoolExecutor(max_workers=128) as ex:
@@ -292,7 +292,7 @@ def run_bridge_bench(n_requests, n_constraints, err=sys.stderr):
                 "throughput_rps": round(n_sub / wall, 1),
                 "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
                 "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
-                "denied": denied[0],
+                "denied": int((~allowed_arr).sum()),
             }
             out.append(r)
             print(f"bridge replay: {r}", file=err)

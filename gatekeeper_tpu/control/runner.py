@@ -138,7 +138,13 @@ class Runner:
         from collections import deque
 
         self.events: Any = deque(maxlen=4096)
-        self._event_seq = 0
+        self._event_queue: Any = deque(maxlen=4096)
+        self._event_wake = threading.Event()
+        self._event_stop = threading.Event()
+        self._event_thread = threading.Thread(
+            target=self._drain_events, daemon=True
+        )
+        self._event_thread.start()
 
         # controllers (wired, not yet watching)
         self.constraint_controller = ConstraintController(
@@ -332,44 +338,86 @@ class Runner:
 
     def _emit_event(self, ev: Dict[str, Any]) -> None:
         """Violation-event sink: the bounded in-memory ring PLUS a real
-        v1 Event written through the EventSource — against a live
-        apiserver these are actual cluster Events (the reference's
-        AnnotatedEventf, policy.go:253-273 / audit emitEvent)."""
+        v1 Event through the EventSource — queued for a background
+        drain thread so the ADMISSION PATH never blocks on an apiserver
+        write (the reference decouples via the event broadcaster the
+        same way, AnnotatedEventf policy.go:253-273 / audit emitEvent)."""
         self.events.append(ev)
         try:
-            import time as _time
+            self._event_queue.append(ev)
+            self._event_wake.set()
+        except Exception:
+            pass
 
-            self._event_seq += 1
-            ts = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
-            ns = ev.get("resource_namespace") or "gatekeeper-system"
-            self.cluster.apply(
-                {
-                    "apiVersion": "v1",
-                    "kind": "Event",
-                    "metadata": {
-                        "name": (
-                            f"gatekeeper-tpu.{self._event_seq}."
-                            f"{int(_time.time() * 1000):x}"
-                        ),
-                        "namespace": ns,
-                    },
-                    "type": ev.get("type", "Warning"),
-                    "reason": ev.get("reason", "Violation"),
-                    "message": ev.get("message", ""),
-                    "source": {"component": "gatekeeper-tpu"},
-                    "involvedObject": {
-                        "kind": ev.get("resource_kind", ""),
-                        "namespace": ev.get("resource_namespace", ""),
-                        "name": ev.get("resource_name", ""),
-                    },
-                    "firstTimestamp": ts,
-                    "lastTimestamp": ts,
-                    "count": 1,
-                }
-            )
-        except Exception as e:
-            # Event emission is best-effort in the reference too
-            self.log.debug("event emission failed", err=str(e))
+    def _drain_events(self) -> None:
+        import hashlib
+        import time as _time
+
+        while not self._event_stop.is_set():
+            self._event_wake.wait(timeout=1.0)
+            self._event_wake.clear()
+            while True:
+                try:
+                    ev = self._event_queue.popleft()
+                except IndexError:
+                    break
+                try:
+                    ts = _time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", _time.gmtime()
+                    )
+                    ns = ev.get("resource_namespace") or "gatekeeper-system"
+                    # deterministic name per (reason, object, message):
+                    # re-emissions AGGREGATE via count/lastTimestamp like
+                    # the reference's recorder instead of accumulating a
+                    # new Event object per sweep forever
+                    key = "|".join(
+                        str(ev.get(k, ""))
+                        for k in (
+                            "reason",
+                            "resource_kind",
+                            "resource_namespace",
+                            "resource_name",
+                            "constraint_name",
+                            "message",
+                        )
+                    )
+                    name = (
+                        "gatekeeper-tpu."
+                        + hashlib.sha1(key.encode()).hexdigest()[:16]
+                    )
+                    gvk = GVK("", "v1", "Event")
+                    count = 1
+                    first_ts = ts
+                    getter = getattr(self.cluster, "get", None)
+                    if getter is not None:
+                        cur = getter(gvk, ns, name)
+                        if cur is not None:
+                            count = int(cur.get("count") or 0) + 1
+                            first_ts = cur.get("firstTimestamp", ts)
+                    self.cluster.apply(
+                        {
+                            "apiVersion": "v1",
+                            "kind": "Event",
+                            "metadata": {"name": name, "namespace": ns},
+                            "type": ev.get("type", "Warning"),
+                            "reason": ev.get("reason", "Violation"),
+                            "message": ev.get("message", ""),
+                            "source": {"component": "gatekeeper-tpu"},
+                            "involvedObject": {
+                                "kind": ev.get("resource_kind", ""),
+                                "namespace": ev.get(
+                                    "resource_namespace", ""
+                                ),
+                                "name": ev.get("resource_name", ""),
+                            },
+                            "firstTimestamp": first_ts,
+                            "lastTimestamp": ts,
+                            "count": count,
+                        }
+                    )
+                except Exception as e:
+                    # Event emission is best-effort in the reference too
+                    self.log.debug("event emission failed", err=str(e))
 
     def _wait_ingested(self, timeout: float = 30.0) -> bool:
         """Block until ingestion satisfies the readiness barrier."""
@@ -402,6 +450,8 @@ class Runner:
 
     def stop(self) -> None:
         self.switch.stop()
+        self._event_stop.set()
+        self._event_wake.set()
         if self.ca_injector is not None:
             self.ca_injector.stop()
         if self.audit is not None:

@@ -10,8 +10,9 @@
 //
 // Protocol (frontend <-> backend): length-prefixed frames over one Unix
 // socket per in-flight request — [u32 big-endian length][payload]. The
-// request payload is the raw AdmissionReview JSON body; the response
-// payload is the complete AdmissionReview response JSON.
+// request payload is "<http path>\n<raw AdmissionReview JSON body>"
+// (the backend routes /v1/admit vs /v1/admitlabel on the first line);
+// the response payload is the complete AdmissionReview response JSON.
 //
 // Failure semantics mirror the reference's fail-open posture
 // (failurePolicy: Ignore, policy.go:80): a backend that is down or
@@ -24,12 +25,14 @@
 // Prints "LISTENING <port>" on stdout once bound.
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <signal.h>
 #include <sys/socket.h>
 #include <sys/un.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -53,20 +56,14 @@ struct Config {
 
 std::atomic<bool> g_stop{false};
 
-ssize_t read_full(int fd, void* buf, size_t n, int timeout_ms) {
-  size_t got = 0;
-  auto* p = static_cast<char*>(buf);
-  while (got < n) {
-    struct pollfd pfd{fd, POLLIN, 0};
-    int pr = poll(&pfd, 1, timeout_ms);
-    if (pr <= 0) return -1;  // timeout or error
-    ssize_t r = read(fd, p + got, n - got);
-    if (r <= 0) return -1;
-    got += static_cast<size_t>(r);
-  }
-  return static_cast<ssize_t>(got);
+int64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
 }
 
+// blocking write toward the HTTP client (the apiserver side has its own
+// webhook timeout; our --deadline-ms governs only the backend hop)
 bool write_full(int fd, const void* buf, size_t n) {
   size_t sent = 0;
   const char* p = static_cast<const char*>(buf);
@@ -78,8 +75,66 @@ bool write_full(int fd, const void* buf, size_t n) {
   return true;
 }
 
+// `deadline` is an absolute CLOCK_MONOTONIC ms instant: the whole
+// backend round trip shares ONE budget (per-poll timeouts would let a
+// trickling or stalled peer stretch it arbitrarily).
+ssize_t read_deadline(int fd, void* buf, size_t n, int64_t deadline) {
+  size_t got = 0;
+  auto* p = static_cast<char*>(buf);
+  while (got < n) {
+    int remain = static_cast<int>(deadline - now_ms());
+    if (remain <= 0) return -1;
+    struct pollfd pfd{fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, remain);
+    if (pr <= 0) return -1;  // timeout or error
+    ssize_t r = read(fd, p + got, n - got);
+    if (r <= 0) return -1;
+    got += static_cast<size_t>(r);
+  }
+  return static_cast<ssize_t>(got);
+}
+
+bool write_deadline(int fd, const void* buf, size_t n, int64_t deadline) {
+  size_t sent = 0;
+  const char* p = static_cast<const char*>(buf);
+  while (sent < n) {
+    int remain = static_cast<int>(deadline - now_ms());
+    if (remain <= 0) return false;
+    struct pollfd pfd{fd, POLLOUT, 0};
+    int pr = poll(&pfd, 1, remain);
+    if (pr <= 0) return false;
+    ssize_t w = write(fd, p + sent, n - sent);
+    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) continue;
+    if (w <= 0) return false;
+    sent += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool connect_deadline(int fd, const struct sockaddr* addr, socklen_t alen,
+                      int64_t deadline) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  int rc = connect(fd, addr, alen);
+  if (rc != 0 && errno != EINPROGRESS) return false;
+  if (rc != 0) {
+    int remain = static_cast<int>(deadline - now_ms());
+    if (remain <= 0) return false;
+    struct pollfd pfd{fd, POLLOUT, 0};
+    if (poll(&pfd, 1, remain) <= 0) return false;
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) != 0 || err != 0)
+      return false;
+  }
+  return true;  // socket stays non-blocking; read/write poll anyway
+}
+
 // One round trip to the Python batch server; empty string = failure.
-std::string backend_call(const Config& cfg, const std::string& body) {
+// The frame payload is "<path>\n<body>" so the backend can route.
+std::string backend_call(const Config& cfg, const std::string& path,
+                         const std::string& body) {
+  int64_t deadline = now_ms() + cfg.deadline_ms;
   int fd = socket(AF_UNIX, SOCK_STREAM, 0);
   if (fd < 0) return "";
   struct sockaddr_un addr;
@@ -87,19 +142,20 @@ std::string backend_call(const Config& cfg, const std::string& body) {
   addr.sun_family = AF_UNIX;
   std::strncpy(addr.sun_path, cfg.backend.c_str(),
                sizeof(addr.sun_path) - 1);
-  if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
-              sizeof(addr)) != 0) {
+  if (!connect_deadline(fd, reinterpret_cast<struct sockaddr*>(&addr),
+                        sizeof(addr), deadline)) {
     close(fd);
     return "";
   }
-  uint32_t len = htonl(static_cast<uint32_t>(body.size()));
-  if (!write_full(fd, &len, 4) ||
-      !write_full(fd, body.data(), body.size())) {
+  std::string payload = path + "\n" + body;
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  if (!write_deadline(fd, &len, 4, deadline) ||
+      !write_deadline(fd, payload.data(), payload.size(), deadline)) {
     close(fd);
     return "";
   }
   uint32_t rlen_be = 0;
-  if (read_full(fd, &rlen_be, 4, cfg.deadline_ms) != 4) {
+  if (read_deadline(fd, &rlen_be, 4, deadline) != 4) {
     close(fd);
     return "";
   }
@@ -109,7 +165,7 @@ std::string backend_call(const Config& cfg, const std::string& body) {
     return "";
   }
   std::string out(rlen, '\0');
-  if (read_full(fd, out.data(), rlen, cfg.deadline_ms) !=
+  if (read_deadline(fd, out.data(), rlen, deadline) !=
       static_cast<ssize_t>(rlen)) {
     close(fd);
     return "";
@@ -211,7 +267,7 @@ bool handle_one(const Config& cfg, int fd) {
     respond(fd, 404, "Not Found", "{\"error\":\"not found\"}", true);
     return true;
   }
-  std::string out = backend_call(cfg, body);
+  std::string out = backend_call(cfg, path, body);
   if (out.empty()) out = fail_open_response(body);
   respond(fd, 200, "OK", out, true);
   return true;

@@ -64,10 +64,16 @@ def build_frontend(force: bool = False) -> Optional[str]:
 
 
 class BatchBridgeServer:
-    """Unix-socket frame server feeding the micro-batching handler."""
+    """Unix-socket frame server feeding the micro-batching handler.
 
-    def __init__(self, handler, socket_path: str, logger=None):
+    Frames carry "<http path>\\n<body>": /v1/admit routes to `handler`,
+    /v1/admitlabel to `label_handler` (the ns-label webhook, mirroring
+    webhook/server.py's in-process routing)."""
+
+    def __init__(self, handler, socket_path: str, label_handler=None,
+                 logger=None):
         self.handler = handler  # ValidationHandler-compatible .handle()
+        self.label_handler = label_handler
         self.socket_path = socket_path
         self.log = logger if logger is not None else null_logger()
         self._sock: Optional[socket.socket] = None
@@ -137,11 +143,15 @@ class BatchBridgeServer:
             except OSError:
                 pass
 
-    def _process(self, body: bytes) -> bytes:
+    def _process(self, frame: bytes) -> bytes:
         try:
+            path, _, body = frame.partition(b"\n")
+            handler = self.handler
+            if path == b"/v1/admitlabel" and self.label_handler is not None:
+                handler = self.label_handler
             review = json.loads(body)
             request = review.get("request") or {}
-            resp = self.handler.handle(request)
+            resp = handler.handle(request)
             doc = {
                 "apiVersion": review.get(
                     "apiVersion", "admission.k8s.io/v1"
@@ -175,15 +185,21 @@ class BridgeStack:
         port: int = 0,
         deadline_ms: int = 2000,
         window_ms: float = 2.0,
+        exempt_namespaces=(),
         **handler_kwargs,
     ):
+        from .namespacelabel import NamespaceLabelHandler
         from .server import BatchedValidationHandler, MicroBatcher
 
         self.batcher = MicroBatcher(client, target, window_ms=window_ms)
         self.handler = BatchedValidationHandler(
             self.batcher, **handler_kwargs
         )
-        self.backend = BatchBridgeServer(self.handler, socket_path)
+        self.backend = BatchBridgeServer(
+            self.handler,
+            socket_path,
+            label_handler=NamespaceLabelHandler(exempt_namespaces),
+        )
         self.socket_path = socket_path
         self.deadline_ms = deadline_ms
         self.requested_port = port
@@ -196,20 +212,26 @@ class BridgeStack:
             raise RuntimeError("no C++ toolchain for the bridge frontend")
         self.batcher.start()
         self.backend.start()
-        self._proc = subprocess.Popen(
-            [
-                binary,
-                "--port", str(self.requested_port),
-                "--backend", self.socket_path,
-                "--deadline-ms", str(self.deadline_ms),
-            ],
-            stdout=subprocess.PIPE,
-            text=True,
-        )
-        line = self._proc.stdout.readline().strip()
-        if not line.startswith("LISTENING "):
-            raise RuntimeError(f"frontend failed to start: {line!r}")
-        self.port = int(line.split()[1])
+        try:
+            self._proc = subprocess.Popen(
+                [
+                    binary,
+                    "--port", str(self.requested_port),
+                    "--backend", self.socket_path,
+                    "--deadline-ms", str(self.deadline_ms),
+                ],
+                stdout=subprocess.PIPE,
+                text=True,
+            )
+            line = self._proc.stdout.readline().strip()
+            if not line.startswith("LISTENING "):
+                raise RuntimeError(f"frontend failed to start: {line!r}")
+            self.port = int(line.split()[1])
+        except Exception:
+            # never leak the running batcher/backend (callers invoke
+            # start() before entering their try/finally)
+            self.stop()
+            raise
 
     def stop(self) -> None:
         if self._proc is not None:

@@ -208,3 +208,54 @@ def test_bridge_fails_open_when_backend_down(tmp_path):
         assert out["response"]["uid"] == "uid-9"
     finally:
         proc.terminate()
+
+
+def test_bridge_routes_admitlabel(tmp_path):
+    """/v1/admitlabel reaches the namespace-label handler through the
+    bridge (the frame protocol carries the HTTP path)."""
+    from gatekeeper_tpu.webhook.bridge import BridgeStack
+
+    stack = BridgeStack(
+        make_client(), TARGET, str(tmp_path / "gl.sock"),
+        deadline_ms=30000, exempt_namespaces=["exempt-ns"],
+    )
+    stack.start()
+    try:
+        def label_review(ns, labels):
+            return json.dumps(
+                {
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": {
+                        "uid": "lu",
+                        "kind": {"group": "", "version": "v1",
+                                 "kind": "Namespace"},
+                        "operation": "CREATE",
+                        "name": ns,
+                        "object": {
+                            "apiVersion": "v1",
+                            "kind": "Namespace",
+                            "metadata": {"name": ns, "labels": labels},
+                        },
+                    },
+                }
+            ).encode()
+
+        # setting the ignore label on a non-exempt namespace is denied
+        deny = post(
+            stack.port,
+            label_review("app-ns",
+                         {"admission.gatekeeper.sh/ignore": "yes"}),
+            path="/v1/admitlabel",
+        )
+        assert deny["response"]["allowed"] is False
+        # exempt namespaces may set it
+        ok = post(
+            stack.port,
+            label_review("exempt-ns",
+                         {"admission.gatekeeper.sh/ignore": "yes"}),
+            path="/v1/admitlabel",
+        )
+        assert ok["response"]["allowed"] is True
+    finally:
+        stack.stop()

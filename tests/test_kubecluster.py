@@ -526,7 +526,14 @@ def test_runner_e2e_against_apiserver(mock):
         assert vwh["webhooks"][0]["clientConfig"].get("caBundle")
 
         # violation events became REAL v1 Events through the apiserver
-        events = mock.store.list(GVK("", "v1", "Event"))
+        # (queued and drained by a background thread: wait briefly)
+        deadline = time.monotonic() + 10
+        events = []
+        while time.monotonic() < deadline:
+            events = mock.store.list(GVK("", "v1", "Event"))
+            if events:
+                break
+            time.sleep(0.1)
         assert events and any(
             e.get("reason") == "AuditViolation"
             and (e.get("involvedObject") or {}).get("name") == "bad"
