@@ -214,12 +214,17 @@ class KubeCluster(EventSource):
                 return []
             raise
 
-    def get(self, gvk: GVK, namespace: str, name: str) -> Optional[dict]:
+    def _collection_path(self, gvk: GVK, namespace: str = "") -> str:
+        """Collection path, namespaced when the kind is and a namespace
+        is given (/api/v1/namespaces/<ns>/pods vs /api/v1/pods)."""
         path, namespaced = self._gvk_path(gvk)
         if namespaced and namespace:
-            path = path.rsplit("/", 1)[0] + (
-                f"/namespaces/{namespace}/" + path.rsplit("/", 1)[1]
-            )
+            head, plural = path.rsplit("/", 1)
+            return f"{head}/namespaces/{namespace}/{plural}"
+        return path
+
+    def get(self, gvk: GVK, namespace: str, name: str) -> Optional[dict]:
+        path = self._collection_path(gvk, namespace)
         try:
             obj = self._request("GET", f"{path}/{name}")
         except KubeError as e:
@@ -256,14 +261,10 @@ class KubeCluster(EventSource):
     # -- writes --------------------------------------------------------------
 
     def _obj_path(self, obj: Dict[str, Any]) -> str:
-        gvk = GVK.from_obj(obj)
-        path, namespaced = self._gvk_path(gvk)
         meta = obj.get("metadata") or {}
-        ns = meta.get("namespace")
-        if namespaced and ns:
-            head, plural = path.rsplit("/", 1)
-            return f"{head}/namespaces/{ns}/{plural}"
-        return path
+        return self._collection_path(
+            GVK.from_obj(obj), meta.get("namespace") or ""
+        )
 
     def apply(self, obj: Dict[str, Any]) -> None:
         """Create-or-replace (the status plane's write-with-retry,
@@ -301,10 +302,7 @@ class KubeCluster(EventSource):
             meta = obj_or_gvk.get("metadata") or {}
             ns = meta.get("namespace") or ""
             name = meta.get("name") or ""
-        path, namespaced = self._gvk_path(gvk)
-        if namespaced and ns:
-            head, plural = path.rsplit("/", 1)
-            path = f"{head}/namespaces/{ns}/{plural}"
+        path = self._collection_path(gvk, ns)
         try:
             self._request("DELETE", f"{path}/{name}")
             return True

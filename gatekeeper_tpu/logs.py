@@ -105,13 +105,15 @@ class StructuredLogger:
         self._emit("error", msg, kv)
 
 
+class _NullStream:
+    def write(self, s) -> None:
+        pass
+
+
 # level "off" short-circuits _emit BEFORE record construction: the
 # audit path logs per violation, and a sweep with tens of thousands of
 # violations must not pay json.dumps into a void when nothing is wired
-_null = StructuredLogger(
-    stream=type("Null", (), {"write": staticmethod(lambda s: None)})(),
-    level="off",
-)
+_null = StructuredLogger(stream=_NullStream(), level="off")
 
 
 def null_logger() -> StructuredLogger:
@@ -126,7 +128,7 @@ class CapturingLogger(StructuredLogger):
     def __init__(self, level: str = "debug"):
         self.records: List[Dict[str, Any]] = []
         super().__init__(
-            stream=type("Null", (), {"write": staticmethod(lambda s: None)})(),
+            stream=_NullStream(),
             level=level,
             sink=self.records.append,
         )

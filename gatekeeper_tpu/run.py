@@ -110,7 +110,7 @@ def main(argv=None) -> int:
     cluster, runner = build_runner(args, log=log)
     log.info(
         "starting gatekeeper-tpu",
-        operations=args.operation or ["webhook", "audit", "status"],
+        operations=sorted(runner.operations),
         webhook_port=args.port,
         health_port=args.health_addr_port,
     )
