@@ -367,31 +367,28 @@ class _BranchEval:
         return np.asarray(expr.emit(self.ctx))
 
     def arr(self, expr: Expr, space: Tuple[str, ...]) -> np.ndarray:
-        """Evaluate and expand to the current element space."""
+        """Evaluate and expand to [n, *element dims] of the current
+        element space (scalar/ELit results broadcast too)."""
         key = (id(expr), self._cond_space)
         hit = self._cache.get(key)
         if hit is not None:
             return hit
         v = np.asarray(expr.emit(self.ctx))
         target = self._cond_space
-        if space != target:
-            try:
-                if space == ():
-                    shape = v.shape + tuple(
-                        _axlen(self.ctx, a) for a in target
-                    )
-                    v = np.broadcast_to(
-                        v.reshape(v.shape + (1,) * len(target)), shape
-                    )
-                else:
+        want = (self.ctx.n,) + tuple(_axlen(self.ctx, a) for a in target)
+        try:
+            if v.ndim == 0:
+                v = np.broadcast_to(v, want)
+            elif space == () and target:
+                v = np.broadcast_to(
+                    v.reshape(v.shape + (1,) * len(target)), want
+                )
+            else:
+                if space != target:
                     v = _expand(self.ctx, v, space, target)
-                    v = np.broadcast_to(
-                        v,
-                        (self.ctx.n,)
-                        + tuple(_axlen(self.ctx, a) for a in target),
-                    )
-            except ValueError:
-                raise _CantRender(f"expand {space} -> {target}")
+                v = np.broadcast_to(v, want)
+        except ValueError:
+            raise _CantRender(f"expand {space} -> {target}")
         self._cache[key] = v
         return v
 
