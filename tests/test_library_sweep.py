@@ -286,3 +286,31 @@ def test_all_library_templates_review_parity(sweep_clients):
         )
         name = (obj.get("metadata") or {}).get("name")
         assert got == want, f"review divergence on {name}"
+
+
+def test_library_routing_classes(sweep_clients):
+    """Regression net over HOW each template routes: every library
+    template must compile (no wholesale interpreter fallback), all but
+    the two genuine data.inventory joins must carry compiled render
+    branches, and uniqueserviceselector must carry its prune plan."""
+    _, tpu, drv = sweep_clients
+    cs = drv._constraint_set(TARGET)
+    by_kind = {}
+    for c, p in zip(cs.constraints, cs.programs):
+        by_kind[c["kind"]] = p
+    inventory_joins = {"K8sUniqueIngressHost", "K8sUniqueServiceSelector"}
+    for tdir, (kind, _params, _kinds) in SWEEP.items():
+        p = by_kind[kind]
+        assert p is not None, f"{kind} fell back to the interpreter"
+        if kind in inventory_joins:
+            assert p.screen, kind
+        else:
+            assert p.branches, f"{kind} lost its render branches"
+            assert all(b.plan is not None for b in p.branches), (
+                f"{kind} has render-less branches"
+            )
+    assert by_kind["K8sUniqueServiceSelector"].prune == {
+        "fn": "flatten_selector",
+        "review_prefix": ("object",),
+        "tree": "namespace",
+    }
