@@ -925,14 +925,18 @@ class TpuDriver(RegoDriver):
             # violating pairs render from their branch plans via one
             # numpy evaluation over the violating rows — no interpreter.
             # Pairs the plans cannot prove exact fall through below.
-            uncached = [
-                p
-                for p in pairs
-                if render_cache is None or p not in render_cache
-            ]
-            host_rendered = self._host_render_pairs(
-                cs, corpus, uncached, reviews
-            )
+            # Traced requests keep the interpreter route so their traces
+            # carry the per-pair evaluation lines.
+            host_rendered: Dict[Tuple[int, int], List[Result]] = {}
+            if trace is None:
+                uncached = [
+                    p
+                    for p in pairs
+                    if render_cache is None or p not in render_cache
+                ]
+                host_rendered = self._host_render_pairs(
+                    cs, corpus, uncached, reviews
+                )
             per_review: List[List[Result]] = [[] for _ in reviews]
             n_results = 0
             n_host = 0
