@@ -627,13 +627,23 @@ class FusedAuditKernel:
                 )
                 # fuse the five outputs into ONE int32 buffer: a
                 # device->host fetch pays the tunnel RTT per ARRAY (the
-                # copies do not overlap), so five leaves cost five RTTs
+                # copies do not overlap), so five leaves cost five RTTs.
+                # Bytes pack into words with EXPLICIT little-endian
+                # shifts (bitcast_convert_type's byte assembly is
+                # platform-defined; the host unpack views '<u4')
                 k_chunks, p8 = packed.shape
                 pad = (-p8) % 4
-                pw = jnp.pad(packed, ((0, 0), (0, pad))).reshape(
-                    k_chunks, (p8 + pad) // 4, 4
+                pw = (
+                    jnp.pad(packed, ((0, 0), (0, pad)))
+                    .reshape(k_chunks, (p8 + pad) // 4, 4)
+                    .astype(jnp.int32)
                 )
-                pwords = jax.lax.bitcast_convert_type(pw, jnp.int32)
+                pwords = (
+                    pw[..., 0]
+                    | (pw[..., 1] << 8)
+                    | (pw[..., 2] << 16)
+                    | (pw[..., 3] << 24)
+                )
                 return jnp.concatenate(
                     [
                         pwords,
@@ -669,6 +679,7 @@ class FusedAuditKernel:
         w4 = -(-p8 // 4)
         packed = (
             np.ascontiguousarray(buf[:, :w4])
+            .astype("<u4")
             .view(np.uint8)
             .reshape(corpus.k, -1)[:, :p8]
         )
