@@ -194,16 +194,34 @@ class KubeCluster(EventSource):
 
     # -- reads ---------------------------------------------------------------
 
+    # page size for chunked Lists (the reference's --audit-chunk-size
+    # posture, audit/manager.go:50,280-334: big clusters must not be
+    # fetched as one giant response)
+    list_chunk_size = 500
+
     def _list_raw(self, gvk: GVK) -> Tuple[List[Dict[str, Any]], str]:
         path, _ = self._gvk_path(gvk)
-        doc = self._request("GET", path)
-        items = doc.get("items") or []
+        items: List[Dict[str, Any]] = []
+        rv = ""
+        cont = ""
+        while True:
+            qs = f"?limit={self.list_chunk_size}"
+            if cont:
+                from urllib.parse import quote
+
+                qs += f"&continue={quote(cont)}"
+            doc = self._request("GET", path + qs)
+            items.extend(doc.get("items") or [])
+            meta = doc.get("metadata") or {}
+            rv = meta.get("resourceVersion", rv)
+            cont = meta.get("continue") or ""
+            if not cont:
+                break
         for it in items:
             # list items omit apiVersion/kind; the control plane keys on
             # them (GVK.from_obj)
             it.setdefault("apiVersion", gvk.api_version)
             it.setdefault("kind", gvk.kind)
-        rv = (doc.get("metadata") or {}).get("resourceVersion", "")
         return items, rv
 
     def list(self, gvk: GVK) -> List[Dict[str, Any]]:

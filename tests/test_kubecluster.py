@@ -76,6 +76,7 @@ class MockApiServer:
 
     def __init__(self):
         self.store = FakeCluster()
+        self.list_requests = 0
         self._rv = 0
         self._rv_lock = threading.Lock()
         self._by_path = {}
@@ -248,13 +249,15 @@ class MockApiServer:
             o for o in self.store.list(gvk)
             if not ns or (o.get("metadata") or {}).get("namespace") == ns
         ]
-        return h._json(
-            200,
-            {
-                "items": items,
-                "metadata": {"resourceVersion": str(self._rv)},
-            },
-        )
+        # chunked Lists (limit/continue), like a real apiserver
+        self.list_requests += 1
+        limit = int(q.get("limit", ["0"])[0] or 0)
+        start = int(q.get("continue", ["0"])[0] or 0)
+        meta = {"resourceVersion": str(self._rv)}
+        if limit and start + limit < len(items):
+            meta["continue"] = str(start + limit)
+        page = items[start:start + limit] if limit else items[start:]
+        return h._json(200, {"items": page, "metadata": meta})
 
     def _serve_watch(self, h, gvk, q):
         timeout = float(q.get("timeoutSeconds", ["30"])[0])
@@ -641,3 +644,19 @@ def test_late_crd_establishment_is_rediscovered(mock):
     finally:
         unsub()
         kc.stop()
+
+
+def test_list_pagination(mock):
+    """Chunked Lists: limit/continue pages are followed to completion
+    (the reference's --audit-chunk-size posture)."""
+    kc = KubeCluster(base_url=mock.url)
+    kc.list_chunk_size = 7
+    for i in range(23):
+        mock.seed(pod(f"pg{i}"))
+    mock.list_requests = 0
+    pods = kc.list(GVK("", "v1", "Pod"))
+    assert len(pods) == 23
+    assert {p["metadata"]["name"] for p in pods} == {
+        f"pg{i}" for i in range(23)
+    }
+    assert mock.list_requests == 4  # 7+7+7+2
