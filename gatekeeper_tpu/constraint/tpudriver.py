@@ -81,8 +81,13 @@ N_CHUNK = 32768
 # serially on the interpreter — a per-request interp review costs ~10ms
 # while a fused device dispatch pays a fixed round trip (~100-200ms on a
 # tunneled chip) plus encode/stage; large batches amortize it. Tunable
-# per deployment (a locally-attached chip could set this to ~2).
-MIN_DEVICE_BATCH = 12
+# per deployment via GATEKEEPER_TPU_MIN_DEVICE_BATCH (a locally-attached
+# chip with ~1ms dispatch wants ~2; the tunneled bench chip wants ~12).
+import os as _os
+
+MIN_DEVICE_BATCH = int(
+    _os.environ.get("GATEKEEPER_TPU_MIN_DEVICE_BATCH", "12")
+)
 
 
 def _params_key(params: Any) -> str:
