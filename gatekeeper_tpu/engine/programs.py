@@ -138,6 +138,7 @@ class ProgramEvaluator:
             for k, v in arrs.items()
         }
         ov = overlay or {}
+        g0, g1 = (g if isinstance(g, tuple) else (g, g))
         ctx = EvalCtx(
             np=np,
             tok=tok,
@@ -149,8 +150,8 @@ class ProgramEvaluator:
                 if k not in ("pat_member", "pat_capture")
             },
             consts=program.consts,
-            g0=g,
-            g1=g,
+            g0=g0,
+            g1=g1,
             v_base=ov.get("v_base"),
             ov_member=ov.get("member"),
             ov_capture=ov.get("capture"),
@@ -196,6 +197,7 @@ class ProgramEvaluator:
                 }
                 outs = []
                 for expr, consts in zip(exprs, const_list):
+                    g0_, g1_ = (g if isinstance(g, tuple) else (g, g))
                     ctx = EvalCtx(
                         np=jnp,
                         tok=tok_in,
@@ -203,8 +205,8 @@ class ProgramEvaluator:
                         pat_capture=tabs["pat_capture"],
                         str_tables=str_tabs,
                         consts=consts,
-                        g0=g,
-                        g1=g,
+                        g0=g0_,
+                        g1=g1_,
                     )
                     outs.append(expr.emit(ctx).astype(jnp.int32))
                 return jnp.stack(outs, axis=0)

@@ -70,6 +70,13 @@ def _get_overlapped(out):
     return jax.device_get(out)
 
 
+def _g01(g) -> Tuple[int, int]:
+    """Fanout argument: an int (g0 == g1 == g, legacy) or (g0, g1)."""
+    if isinstance(g, tuple):
+        return int(g[0]), int(g[1])
+    return int(g), int(g)
+
+
 def _pad_len(n: int) -> int:
     """Padded vocab-axis capacity: next power of two with headroom for
     at least one full delta chunk, so growth stays in-bucket for a
@@ -689,9 +696,10 @@ class FusedAuditKernel:
         si = buf[:, w4 + r_eff + 2]
         return packed, hot, n_hot, sc, si
 
-    def _need_chunk_fn(self, policy: StagedPolicy, g: int, r_cap: int):
+    def _need_chunk_fn(self, policy: StagedPolicy, g, r_cap: int):
         """The shared per-chunk need computation (trace-time closure
         over the policy's program groups)."""
+        g0_, g1_ = _g01(g)
         group_exprs = policy.group_exprs
         group_rows = policy.group_rows
         group_cmaps = policy.group_cmaps
@@ -770,8 +778,8 @@ class FusedAuditKernel:
                         pat_capture=tabs_in["pat_capture"],
                         str_tables=str_tabs,
                         consts=consts,
-                        g0=g,
-                        g1=g,
+                        g0=g0_,
+                        g1=g1_,
                         slabs=slabs,
                         slab_cols=slab_cols,
                         row=row_in,
@@ -982,6 +990,7 @@ class FusedAuditKernel:
                     ):
 
                         def eval_one(consts):
+                            g0_, g1_ = _g01(g)
                             ctx = EvalCtx(
                                 np=jnp,
                                 tok=tok_in,
@@ -989,8 +998,8 @@ class FusedAuditKernel:
                                 pat_capture=tabs_in["pat_capture"],
                                 str_tables=str_tabs,
                                 consts=consts,
-                                g0=g,
-                                g1=g,
+                                g0=g0_,
+                                g1=g1_,
                             )
                             return expr.emit(ctx).astype(jnp.int32)
 
