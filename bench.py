@@ -25,7 +25,20 @@ from nothing in the headline.
 
 Prints exactly ONE JSON line on stdout; human detail on stderr.
 
-Usage: python bench.py [N_RESOURCES] [N_CONSTRAINTS]   (default 100000 500)
+Outage resilience (VERDICT r4 weak #1): the round's primary artifact is
+this script's one JSON line, so a wedged TPU tunnel must DEGRADE the
+number, not erase it. The process re-execs itself as a child benchmark
+after deciding the platform: if the axon env is present, a short
+subprocess probe checks the tunnel actually answers; on probe failure
+(or a mid-run child crash) the bench re-runs in a CPU child with the
+axon plugin scrubbed from the environment entirely (PYTHONPATH strip +
+PALLAS_AXON_POOL_IPS pop — the sitecustomize no-ops without it), at a
+CPU-feasible workload size. The JSON line always carries `platform` and
+`degraded` fields, and the orchestrator exits 0 even when everything
+fails (the line then reports the error in detail.error).
+
+Usage: python bench.py [N_RESOURCES] [N_CONSTRAINTS]
+(default 100000 500 on TPU; 10000 100 on the degraded CPU path)
 """
 
 import json
@@ -312,14 +325,61 @@ def run_audit_phase(n_resources, n_constraints, adversarial, err):
     }
 
 
-def main():
-    n_resources = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
-    n_constraints = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+def measure_cold_start(err):
+    """Serve-while-compiling cold start (VERDICT r4 #4): a fresh driver
+    with state ingested (the reference's Ready point) must answer its
+    first device-sized admission batch in <5s by serving from the
+    interpreter while the fused kernels compile in the background, then
+    swap to the compiled route. Measures all three legs."""
+    from gatekeeper_tpu.constraint import AugmentedUnstructured, TpuDriver
+    from gatekeeper_tpu.constraint.tpudriver import MIN_DEVICE_BATCH
+
+    drv = TpuDriver()
+    client = build_client(drv, 500, 50)
+    # device-sized relative to the env-tunable routing threshold, or the
+    # batch never goes cold->device and the poll below spins for nothing
+    n_probe = max(16, MIN_DEVICE_BATCH)
+    objs = [AugmentedUnstructured(make_pod(i)) for i in range(n_probe)]
+
+    t0 = time.perf_counter()
+    client.review_many(objs)
+    first_ms = (time.perf_counter() - t0) * 1000
+    served_cold = drv.cold_batches > 0
+
+    t1 = time.perf_counter()
+    while (
+        not drv.review_path_warm(TARGET)
+        and time.perf_counter() - t1 < 300
+    ):
+        time.sleep(0.25)
+    swap_s = time.perf_counter() - t1
+
+    # same bucket the cold batch warmed (the webhook's own warmup covers
+    # every bucket its micro-batcher produces; a novel bucket would pay
+    # its own one-off compile)
+    t2 = time.perf_counter()
+    client.review_many(objs)
+    post_ms = (time.perf_counter() - t2) * 1000
+    out = {
+        "cold_first_admission_ms": round(first_ms, 1),
+        "served_cold_on_interpreter": served_cold,
+        "warm_swap_seconds": round(swap_s, 1),
+        "post_swap_batch_ms": round(post_ms, 1),
+        "cold_target_met": first_ms < 5000,
+    }
+    print(f"cold start: {out}", file=err)
+    return out
+
+
+def run_bench(n_resources, n_constraints):
+    """The actual benchmark (child process). Prints the JSON line."""
     err = sys.stderr
 
     import jax
     from gatekeeper_tpu.constraint import RegoDriver
 
+    platform = jax.devices()[0].platform
+    degraded = os.environ.get("_GRAFT_BENCH_DEGRADED") == "1"
     print(f"devices: {jax.devices()}", file=err)
 
     # -- CPU baseline (subsample, interpreter driver) -----------------------
@@ -337,14 +397,20 @@ def main():
         file=err,
     )
 
+    # -- cold start (serve-while-compiling) ---------------------------------
+    cold_start = measure_cold_start(err)
+
     # -- audit phases -------------------------------------------------------
     clean = run_audit_phase(n_resources, n_constraints, False, err)
     adv = run_audit_phase(n_resources, n_constraints, True, err)
 
     # -- webhook replay (config #4) -----------------------------------------
-    from bench_webhook import run_webhook_bench
+    from bench_webhook import run_constraint_ladder, run_webhook_bench
 
     webhook = run_webhook_bench(10_000, 50, err=err)
+    # latency-vs-policy-count curve, the reference harness's ladder
+    # (policy_benchmark_test.go:265-276; VERDICT r4 #3)
+    ladder = run_constraint_ladder(err=err)
     # reference-comparable number: 100%-violating at low concurrency
     # (policy_benchmark_test.go's shape); allow-path p50 alongside
     p50 = next(
@@ -374,6 +440,8 @@ def main():
                 "metric": "audit_constraint_evals_per_sec_per_chip",
                 "value": rate,
                 "unit": "evals/s",
+                "platform": platform,
+                "degraded": degraded,
                 # measured: TPU rate / this-repo Python interpreter rate
                 # (the reference ARCHITECTURE on the same host); no
                 # unmeasured constant contributes to this number
@@ -381,9 +449,11 @@ def main():
                 "detail": {
                     "n_resources": n_resources,
                     "n_constraints": n_constraints,
+                    "cold_start": cold_start,
                     "clean": clean,
                     "adversarial": adv,
                     "webhook": webhook,
+                    "webhook_constraint_ladder": ladder,
                     "webhook_p50_ms": p50,
                     "webhook_p50_allow_ms": p50_allow,
                     "cpu_python_evals_per_sec": round(cpu_rate, 1),
@@ -398,6 +468,152 @@ def main():
                     "north_star": "100k x 500 < 2s",
                     "north_star_met": clean["sweep_seconds"] < 2.0,
                 },
+            }
+        )
+    )
+
+
+# -- orchestration: platform decision, probe, degraded fallback -------------
+
+CPU_FALLBACK_SIZE = (10_000, 100)  # CPU-feasible workload for the degraded run
+PROBE_TIMEOUT_S = 120  # tunnel backend init is ~15-60s when healthy
+TPU_CHILD_TIMEOUT_S = 5400
+CPU_CHILD_TIMEOUT_S = 3600
+
+
+def _probe_tpu(err):
+    """Does the tunnel actually answer? Bounded subprocess so a wedged
+    backend init cannot hang the bench."""
+    import subprocess
+
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; print(jax.devices()[0].platform)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"tpu probe: TIMEOUT after {PROBE_TIMEOUT_S}s (tunnel wedged)",
+            file=err,
+        )
+        return False
+    dt = time.perf_counter() - t0
+    ok = proc.returncode == 0
+    tail = (proc.stderr or "").strip().splitlines()[-1:] or [""]
+    print(
+        f"tpu probe: rc={proc.returncode} in {dt:.0f}s"
+        + ("" if ok else f" ({tail[0][:200]})"),
+        file=err,
+    )
+    return ok
+
+
+def _run_child(args, env, timeout_s, err):
+    """Run the benchmark child; return (json_line_or_None, failure_str)."""
+    import subprocess
+
+    env = dict(env)
+    env["_GRAFT_BENCH_CHILD"] = "1"
+    # child arms its own faulthandler watchdog just inside the parent's
+    # kill, so a hang leaves a stack trace instead of a bare timeout
+    env["_GRAFT_BENCH_WATCHDOG_S"] = str(max(60, timeout_s - 120))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *map(str, args)],
+            env=env,
+            stdout=subprocess.PIPE,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"child timed out after {timeout_s}s"
+    out = (proc.stdout or "").strip().splitlines()
+    # scan for the JSON line REGARDLESS of exit code: a child that
+    # completed the measurement and printed its line but died in
+    # teardown must not cost the round its number
+    for line in reversed(out):
+        try:
+            json.loads(line)
+            if proc.returncode != 0:
+                print(
+                    f"child rc={proc.returncode} after printing its "
+                    f"JSON line; keeping the result",
+                    file=err,
+                )
+            return line, None
+        except (ValueError, TypeError):
+            continue
+    if proc.returncode != 0:
+        return None, f"child rc={proc.returncode}"
+    return None, "child emitted no JSON line"
+
+
+def main():
+    err = sys.stderr
+    argv_sizes = [int(a) for a in sys.argv[1:3]]
+    if len(argv_sizes) == 1:
+        argv_sizes.append(500)
+
+    if os.environ.get("_GRAFT_BENCH_CHILD") == "1":
+        # child: sizes always explicit; watchdog so a hang leaves a trace
+        import faulthandler
+
+        faulthandler.dump_traceback_later(
+            int(os.environ.get("_GRAFT_BENCH_WATCHDOG_S", "5280")),
+            exit=True, file=err,
+        )
+        run_bench(argv_sizes[0], argv_sizes[1])
+        return
+
+    from gatekeeper_tpu.axonenv import axon_requested, scrub_axon_env
+
+    failures = []
+    if axon_requested() and _probe_tpu(err):
+        sizes = argv_sizes or [100_000, 500]
+        line, fail = _run_child(
+            sizes, os.environ, TPU_CHILD_TIMEOUT_S, err
+        )
+        if line is not None:
+            print(line)
+            return
+        failures.append(f"tpu: {fail}")
+        print(f"tpu child failed ({fail}); degrading to cpu", file=err)
+    elif axon_requested():
+        failures.append("tpu: probe failed (tunnel unreachable)")
+
+    degraded = axon_requested()  # a plain CPU env is not a degradation
+    sizes = argv_sizes or list(CPU_FALLBACK_SIZE)
+    if degraded:
+        # cap TPU-scale sizes at the CPU-feasible workload: the degraded
+        # run must still finish and emit a number, not erase it
+        sizes = [min(s, cap) for s, cap in zip(sizes, CPU_FALLBACK_SIZE)]
+    env = scrub_axon_env()
+    if degraded:
+        env["_GRAFT_BENCH_DEGRADED"] = "1"
+    line, fail = _run_child(sizes, env, CPU_CHILD_TIMEOUT_S, err)
+    if line is not None:
+        print(line)
+        return
+    failures.append(f"cpu: {fail}")
+
+    # last resort: the artifact still parses, carrying the failure story
+    print(
+        json.dumps(
+            {
+                "metric": "audit_constraint_evals_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "evals/s",
+                "vs_baseline": 0.0,
+                "platform": "none",
+                "degraded": True,
+                "detail": {"error": "; ".join(failures)},
             }
         )
     )

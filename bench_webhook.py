@@ -112,6 +112,17 @@ def make_request(i, violating=True):
     }
 
 
+def _warm_route(client):
+    """Synchronously compile the fused review route so replays measure
+    the compiled path (serve-while-compiling otherwise serves cold
+    batches on the interpreter and compiles in the background)."""
+    from gatekeeper_tpu.constraint import AugmentedReview
+
+    client.warm_review_path(
+        [AugmentedReview(make_request(i)) for i in range(16)]
+    )
+
+
 def replay(handler, requests, concurrency):
     lat = np.zeros(len(requests))
 
@@ -169,9 +180,13 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
     handler = BatchedValidationHandler(batcher, request_timeout=60)
     batcher.start()
     try:
-        # warm the jit across the occupancy buckets BOTH concurrency
+        # flip the serve-while-compiling route to warm SYNCHRONOUSLY
+        # first (a cold device-sized batch otherwise serves on the
+        # interpreter and only kicks a background compile), then warm
+        # the jit across the occupancy buckets BOTH concurrency
         # profiles produce (batch-size buckets differ between c=8 and
         # c=128; compiles inside the measured replay would skew p99)
+        _warm_route(client)
         warm = [make_request(i) for i in range(256)]
         replay(handler, warm, 64)
         replay(handler, [make_request(i) for i in range(512)], 128)
@@ -223,6 +238,130 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
     }
 
 
+# the reference harness's constraint-count ladder
+# (pkg/webhook/policy_benchmark_test.go:265-276)
+LADDER = (5, 10, 50, 100, 200, 1000, 2000)
+
+
+def run_constraint_ladder(err=sys.stderr, rungs=LADDER):
+    """Latency-vs-policy-count curve (VERDICT r4 #3): p50/p99/rps per
+    constraint-count rung for all three serving paths — the serial
+    Python-interpreter handler (the reference's architecture, measured
+    serially like the Go b.N loop), the fused micro-batching handler
+    (c=128), and the native C++ bridge stack (c=128). 100%-violating
+    requests, the reference harness's stress shape."""
+    from gatekeeper_tpu.constraint import RegoDriver, TpuDriver
+    from gatekeeper_tpu.webhook import ValidationHandler
+    from gatekeeper_tpu.webhook.bridge import BridgeStack, build_frontend
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    have_bridge = build_frontend() is not None
+    out = []
+    for n_con in rungs:
+        rung = {"constraints": n_con}
+
+        # interpreter path, serial (subsample scaled: per-request cost
+        # grows with the rung)
+        cpu_n = max(25, min(200, 20_000 // n_con))
+        cpu_handler = ValidationHandler(
+            build_webhook_client(RegoDriver(), n_con), TARGET
+        )
+        reqs = [make_request(i) for i in range(cpu_n)]
+        cpu_handler.handle(reqs[0])  # warm
+        t0 = time.perf_counter()
+        lat = np.zeros(cpu_n)
+        for i, r in enumerate(reqs):
+            t1 = time.perf_counter()
+            cpu_handler.handle(r)
+            lat[i] = time.perf_counter() - t1
+        wall = time.perf_counter() - t0
+        rung["interp"] = {
+            "requests": cpu_n,
+            "throughput_rps": round(cpu_n / wall, 1),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+        }
+
+        # fused micro-batching path, c=128
+        client = build_webhook_client(TpuDriver(), n_con)
+        batcher = MicroBatcher(client, TARGET, window_ms=2.0)
+        handler = BatchedValidationHandler(batcher, request_timeout=60)
+        batcher.start()
+        try:
+            _warm_route(client)
+            replay(handler, [make_request(i) for i in range(512)], 128)
+            n_sub = 1500
+            r = replay(handler, [make_request(i) for i in range(n_sub)], 128)
+            rung["fused"] = {
+                k: r[k]
+                for k in ("requests", "throughput_rps", "p50_ms", "p99_ms")
+            }
+        finally:
+            batcher.stop()
+
+        # native bridge stack, c=128 full HTTP
+        if have_bridge:
+            bclient = build_webhook_client(TpuDriver(), n_con)
+            _warm_route(bclient)
+            sock = tempfile.mktemp(prefix="gk-lad-", suffix=".sock")
+            stack = BridgeStack(
+                bclient, TARGET, sock, deadline_ms=60_000,
+                request_timeout=60,
+            )
+            stack.start()
+            try:
+                def post(i):
+                    body = _json.dumps(
+                        {
+                            "apiVersion": "admission.k8s.io/v1",
+                            "kind": "AdmissionReview",
+                            "request": make_request(i),
+                        }
+                    ).encode()
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{stack.port}/v1/admit",
+                        data=body,
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    )
+                    t1 = time.perf_counter()
+                    with urllib.request.urlopen(req, timeout=120) as resp:
+                        resp.read()
+                    return time.perf_counter() - t1
+
+                with ThreadPoolExecutor(max_workers=128) as ex:
+                    list(ex.map(post, range(512)))  # warm
+                n_sub = 1500
+                blat = np.zeros(n_sub)
+
+                def one(i):
+                    blat[i] = post(i)
+
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(max_workers=128) as ex:
+                    list(ex.map(one, range(n_sub)))
+                wall = time.perf_counter() - t0
+                rung["bridge"] = {
+                    "requests": n_sub,
+                    "throughput_rps": round(n_sub / wall, 1),
+                    "p50_ms": round(float(np.percentile(blat, 50)) * 1e3, 2),
+                    "p99_ms": round(float(np.percentile(blat, 99)) * 1e3, 2),
+                }
+            finally:
+                stack.stop()
+        else:
+            rung["bridge"] = {"skipped": "no C++ toolchain"}
+        print(f"constraint ladder rung: {rung}", file=err)
+        out.append(rung)
+    return out
+
+
 def run_bridge_bench(n_requests, n_constraints, err=sys.stderr):
     """The native serving stack (C++ front + unix-socket batch backend):
     full-HTTP replay through the compiled bridge_frontend binary at high
@@ -238,6 +377,7 @@ def run_bridge_bench(n_requests, n_constraints, err=sys.stderr):
     if build_frontend() is None:
         return {"skipped": "no C++ toolchain"}
     client = build_webhook_client(TpuDriver(), n_constraints)
+    _warm_route(client)
     sock = tempfile.mktemp(prefix="gk-bridge-", suffix=".sock")
     stack = BridgeStack(
         client, TARGET, sock, deadline_ms=60_000, request_timeout=60
@@ -302,9 +442,12 @@ def run_bridge_bench(n_requests, n_constraints, err=sys.stderr):
 
 
 if __name__ == "__main__":
-    n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
-    n_con = int(sys.argv[2]) if len(sys.argv) > 2 else 50
     import json
 
-    res = run_webhook_bench(n_req, n_con)
-    print(json.dumps(res))
+    if "--ladder" in sys.argv:
+        print(json.dumps(run_constraint_ladder()))
+    else:
+        n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+        n_con = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+        res = run_webhook_bench(n_req, n_con)
+        print(json.dumps(res))

@@ -1,0 +1,52 @@
+"""Host-platform environment control for the axon TPU tunnel.
+
+The deployment image injects a TPU tunnel via sitecustomize on
+PYTHONPATH (activated by PALLAS_AXON_POOL_IPS); when the tunnel is down,
+ANY jax backend touch in an exposed process hangs indefinitely. Evidence
+harnesses (bench.py, __graft_entry__.py) therefore make the platform
+decision from the ENV ALONE and run CPU work in subprocesses scrubbed by
+these helpers, which must stay importable without touching jax.
+
+Keep both sides of the contract here: bench.py and __graft_entry__.py
+both import this module, so an axon env-contract change (new activation
+var, renamed site dir) lands in one place.
+"""
+
+import os
+
+_SITE_MARKER = ".axon_site"
+_ACTIVATION_VAR = "PALLAS_AXON_POOL_IPS"
+
+
+def axon_requested(environ=os.environ) -> bool:
+    """The env promises a TPU tunnel. Never probe devices to find out:
+    a wedged tunnel hangs any backend touch."""
+    return bool(environ.get(_ACTIVATION_VAR)) and "axon" in (
+        environ.get("JAX_PLATFORMS", "")
+    )
+
+
+def scrub_axon_env(environ=None) -> dict:
+    """A copy of `environ` in which the axon plugin can NEVER load: the
+    sitecustomize no-ops without its activation var, and stripping the
+    site dir from PYTHONPATH removes even the registration hook. Sets
+    JAX_PLATFORMS=cpu so the child claims the CPU backend outright."""
+    env = dict(os.environ if environ is None else environ)
+    env.pop(_ACTIVATION_VAR, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and _SITE_MARKER not in p
+    )
+    return env
+
+
+def claim_cpu_platform() -> None:
+    """Claim the CPU backend at the jax-config level in THIS process,
+    before any backend initializes. The env var alone is not enough when
+    the axon sitecustomize already ran: it sets jax_platforms="axon,cpu"
+    at the config level, which outranks JAX_PLATFORMS."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

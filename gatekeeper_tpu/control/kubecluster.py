@@ -31,6 +31,7 @@ import json
 import os
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -399,16 +400,37 @@ class _Watcher:
     def _loop(self) -> None:
         backoff = 0.2
         while not self._stop.is_set():
-            if not self._resync():
-                self._stop.wait(min(backoff, 30.0))
-                backoff *= 2
-                continue
-            backoff = 0.2
+            # relist-and-diff only when there is no resume point: first
+            # pass, expired/failed stream. A CLEAN server-side close
+            # (the default 300s watch timeout) re-watches straight from
+            # the last bookmark rv like the reference's informers —
+            # relisting there is O(corpus) list traffic per subscription
+            # every few minutes (ADVICE r4).
+            if not self._rv:
+                if not self._resync():
+                    self._stop.wait(min(backoff, 30.0))
+                    backoff *= 2
+                    continue
+                backoff = 0.2
+            started = time.monotonic()
             try:
                 self._watch_once()
+                # clean close: normally re-watch immediately (real
+                # servers close every few minutes) — but a stream that
+                # died in under a second (draining apiserver, proxy
+                # dropping long-lived requests) must not busy-loop
+                # watch requests; back off until streams live again
+                if time.monotonic() - started >= 0.5:
+                    backoff = 0.2
+                else:
+                    self._stop.wait(min(backoff, 30.0))
+                    backoff *= 2
             except KubeError as e:
                 if e.code == 410:
-                    # expired resourceVersion: fall through to relist
+                    # expired resourceVersion: only this invalidates the
+                    # resume point — transient apiserver errors (500s,
+                    # failed establishment) keep _rv and re-watch, no
+                    # O(corpus) relist
                     self._rv = ""
                 else:
                     self.cluster.log.error(
@@ -417,6 +439,9 @@ class _Watcher:
                     self._stop.wait(min(backoff, 30.0))
                     backoff *= 2
             except Exception as e:
+                # mid-stream break (decode error, socket reset): events
+                # may have been lost — relist-and-diff to reconverge
+                self._rv = ""
                 self.cluster.log.error(
                     "watch stream error", err=e, gvk=str(self.gvk)
                 )

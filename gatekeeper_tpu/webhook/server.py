@@ -363,10 +363,13 @@ class WebhookServer:
             # device-sized batches covering the common occupancy
             # buckets (row counts bucket at 64/128/256; sub-device-
             # threshold batches route to the interpreter and need no
-            # compile)
-            self.client.review_many(reviews[:16])
-            self.client.review_many(reviews[:100])
-            self.client.review_many(reviews)
+            # compile). warm_review_path compiles WITHOUT holding the
+            # driver's serving mutex, so admission keeps flowing on the
+            # interpreter route until the compiled route swaps in
+            # (serve-while-compiling, VERDICT r4 #4)
+            self.client.warm_review_path(reviews[:16])
+            self.client.warm_review_path(reviews[:100])
+            self.client.warm_review_path(reviews)
         except Exception:
             pass  # warmup is best-effort; serving still works unwarmed
         self.warm = True

@@ -210,6 +210,137 @@ def test_bridge_fails_open_when_backend_down(tmp_path):
         proc.terminate()
 
 
+def test_bridge_fail_open_uid_ignores_nested_uids(tmp_path):
+    """ADVICE r4: the fail-open response must carry the REQUEST's own
+    uid even when a deeper uid (request.object.metadata.uid) serializes
+    first — the extractor tracks brace depth, not first-match."""
+    from gatekeeper_tpu.webhook.bridge import build_frontend
+    import subprocess
+
+    binary = build_frontend()
+    assert binary
+    proc = subprocess.Popen(
+        [
+            binary, "--port", "0",
+            "--backend", str(tmp_path / "nonexistent.sock"),
+            "--deadline-ms", "500",
+        ],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        body = json.dumps(
+            {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {
+                    "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                    "object": {
+                        "metadata": {"name": "p", "uid": "WRONG-object-uid"}
+                    },
+                    "oldObject": {"metadata": {"uid": "WRONG-old-uid"}},
+                    "uid": "the-request-uid",
+                },
+            }
+        ).encode()
+        out = post(port, body)
+        assert out["response"]["allowed"] is True
+        assert out["response"]["uid"] == "the-request-uid"
+    finally:
+        proc.terminate()
+
+
+def test_bridge_keep_alive_pipelined_requests(tmp_path):
+    """ADVICE r4: bytes read past one request's body on a keep-alive
+    connection belong to the NEXT request — two requests written
+    back-to-back in one send must both be answered in order."""
+    import socket
+
+    from gatekeeper_tpu.webhook.bridge import BridgeStack
+
+    stack = BridgeStack(
+        make_client(), TARGET, str(tmp_path / "gp.sock"),
+        deadline_ms=30000, request_timeout=60,
+    )
+    stack.start()
+    try:
+        def http_req(body):
+            return (
+                b"POST /v1/admit HTTP/1.1\r\n"
+                b"Host: x\r\nContent-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"Connection: keep-alive\r\n\r\n" + body
+            )
+
+        payload = http_req(review_body(1, {})) + http_req(
+            review_body(2, {"owner": "me"})
+        )
+        with socket.create_connection(("127.0.0.1", stack.port), 30) as s:
+            s.sendall(payload)
+            s.settimeout(30)
+            data = b""
+            # read until both responses' bodies are complete
+            uids = []
+            while len(uids) < 2:
+                chunk = s.recv(65536)
+                assert chunk, f"connection closed early; got {data!r}"
+                data += chunk
+                uids = [
+                    json.loads(part)["response"]["uid"]
+                    for part in _http_bodies(data)
+                ]
+        assert uids == ["uid-1", "uid-2"]
+    finally:
+        stack.stop()
+
+
+def _http_bodies(data: bytes):
+    """Complete HTTP response bodies parsed from a byte stream."""
+    out = []
+    rest = data
+    while True:
+        sep = rest.find(b"\r\n\r\n")
+        if sep < 0:
+            return out
+        head = rest[:sep].decode("latin-1").lower()
+        cl = 0
+        for line in head.split("\r\n"):
+            if line.startswith("content-length:"):
+                cl = int(line.split(":", 1)[1].strip())
+        body_start = sep + 4
+        if len(rest) < body_start + cl:
+            return out
+        out.append(rest[body_start:body_start + cl])
+        rest = rest[body_start + cl:]
+
+
+def test_bridge_rejects_chunked_encoding(tmp_path):
+    """ADVICE r4: chunked framing is unimplemented — reject explicitly
+    (501) instead of misparsing the body."""
+    import socket
+
+    from gatekeeper_tpu.webhook.bridge import BridgeStack
+
+    stack = BridgeStack(
+        make_client(), TARGET, str(tmp_path / "gc.sock"),
+        deadline_ms=30000, request_timeout=60,
+    )
+    stack.start()
+    try:
+        with socket.create_connection(("127.0.0.1", stack.port), 30) as s:
+            s.sendall(
+                b"POST /v1/admit HTTP/1.1\r\nHost: x\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"5\r\nhello\r\n0\r\n\r\n"
+            )
+            s.settimeout(30)
+            data = s.recv(65536)
+        assert data.startswith(b"HTTP/1.1 501")
+    finally:
+        stack.stop()
+
+
 def test_bridge_routes_admitlabel(tmp_path):
     """/v1/admitlabel reaches the namespace-label handler through the
     bridge (the frame protocol carries the HTTP path)."""

@@ -245,7 +245,16 @@ def result_key(r):
     )
 
 
-@pytest.mark.parametrize("seed", [7, 1337, 424242])
+# seed 7 anchors the default tier; the longer seeds run nightly
+# (VERDICT r4 weak #6: keep the habitual run under ~3 minutes)
+@pytest.mark.parametrize(
+    "seed",
+    [
+        7,
+        pytest.param(1337, marks=pytest.mark.nightly),
+        pytest.param(424242, marks=pytest.mark.nightly),
+    ],
+)
 def test_fuzz_audit_and_review_parity(seed):
     rego, tpu, drv, objs, rng = build_clients(seed)
     want = sorted(

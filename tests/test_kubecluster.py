@@ -12,7 +12,7 @@ env), the same Runner e2e would run against it unchanged.
 """
 
 import json
-import queue
+
 import threading
 import time
 import urllib.request
@@ -79,6 +79,18 @@ class MockApiServer:
         self.list_requests = 0
         self._rv = 0
         self._rv_lock = threading.Lock()
+        # ordered event log, the watch cache: (rv, type, obj, gvk key).
+        # Watches with ?resourceVersion=N replay entries > N then tail
+        # live appends; an N older than the trim watermark gets the
+        # ERROR-410 line a real apiserver sends on an expired rv.
+        self._log = []
+        self._log_lock = threading.Lock()
+        self.log_retention = 10_000
+        self._min_rv = 0
+        self.bookmark_interval = 0.25
+        self._active_watches = set()
+        self.watch_410s = 0  # expired-rv rejections served
+        self.fail_watch = 0  # inject: next N watch requests get ERROR-500
         self._by_path = {}
         self._groups = {}
         for gvk, plural, namespaced in REGISTRY:
@@ -135,18 +147,71 @@ class MockApiServer:
 
     # -- store helpers -------------------------------------------------------
 
-    def next_rv(self):
+    def _gvk_key(self, gvk):
+        return (gvk.group, gvk.version, gvk.kind)
+
+    def _commit(self, etype, gvk, obj, mutate):
+        """Serialize {rv assignment, store mutation, log append} so the
+        watch loop's head/bookmark logic can trust that every rv <= the
+        observed head is already in the log. Returns the stamped obj."""
         with self._rv_lock:
             self._rv += 1
-            return str(self._rv)
+            meta = dict(obj.get("metadata") or {})
+            meta["resourceVersion"] = str(self._rv)
+            obj = {**obj, "metadata": meta}
+            mutate(obj)
+            with self._log_lock:
+                self._log.append(
+                    (self._rv, etype, obj, self._gvk_key(gvk))
+                )
+                if len(self._log) > self.log_retention:
+                    drop = len(self._log) - self.log_retention
+                    self._min_rv = self._log[drop - 1][0]
+                    del self._log[:drop]
+        return obj
+
+    def kill_watches(self):
+        """Chaos: sever every active watch stream mid-flight (the
+        informer must relist-and-diff or resume from its bookmark).
+        shutdown(), not close(): the handler's makefile objects hold the
+        fd, so close() alone leaves the TCP stream functioning."""
+        import socket as _socket
+
+        for conn in list(self._active_watches):
+            try:
+                conn.shutdown(_socket.SHUT_RDWR)
+            except Exception:
+                pass
+
+    def _exists(self, gvk, ns, name):
+        for cand in self.store.list(gvk):
+            meta = cand.get("metadata") or {}
+            if meta.get("name") == name and (
+                not ns or meta.get("namespace") == ns
+            ):
+                return cand
+        return None
 
     def seed(self, obj):
-        """Apply straight into the backing store (with an rv stamp)."""
-        obj = dict(obj)
-        meta = dict(obj.get("metadata") or {})
-        meta["resourceVersion"] = self.next_rv()
-        obj["metadata"] = meta
-        self.store.apply(obj)
+        """Apply straight into the backing store (with an rv stamp and
+        a watch-log event)."""
+        gvk = GVK.from_obj(obj)
+        meta = obj.get("metadata") or {}
+        existed = self._exists(
+            gvk, meta.get("namespace") or "", meta.get("name") or ""
+        )
+        self._commit(
+            MODIFIED if existed else ADDED, gvk, dict(obj),
+            self.store.apply,
+        )
+
+    def remove(self, obj):
+        """Delete from the backing store with a watch event."""
+        gvk = GVK.from_obj(obj)
+        self._commit(
+            DELETED, gvk, dict(obj),
+            lambda stamped: self.store.delete(obj),
+        )
 
     # -- request handling ----------------------------------------------------
 
@@ -260,33 +325,120 @@ class MockApiServer:
         return h._json(200, {"items": page, "metadata": meta})
 
     def _serve_watch(self, h, gvk, q):
+        """Log-tailing watch with real-apiserver semantics: replay from
+        ?resourceVersion (ERROR-410 line when it predates the log trim
+        watermark), then stream live appends; BOOKMARK events carry the
+        high-water rv when allowWatchBookmarks=true."""
         timeout = float(q.get("timeoutSeconds", ["30"])[0])
-        events = queue.Queue()
-
-        def sink(ev):
-            events.put(ev)
-
-        unsub = self.store.subscribe(gvk, sink)
+        since_s = q.get("resourceVersion", [""])[0]
+        bookmarks = q.get("allowWatchBookmarks", [""])[0] in (
+            "true", "1", "True"
+        )
+        key = self._gvk_key(gvk)
+        last_rv = int(since_s) if since_s else None
+        conn = h.connection
+        self._active_watches.add(conn)
         try:
             h.send_response(200)
             h.send_header("Content-Type", "application/json")
             h.send_header("Connection", "close")
             h.end_headers()
-            deadline = time.monotonic() + min(timeout, 30.0)
-            while time.monotonic() < deadline:
-                try:
-                    ev = events.get(timeout=0.2)
-                except queue.Empty:
-                    continue
-                line = json.dumps(
-                    {"type": ev.type, "object": ev.obj}
-                ).encode() + b"\n"
-                h.wfile.write(line)
+
+            def write_line(doc):
+                h.wfile.write(json.dumps(doc).encode() + b"\n")
                 h.wfile.flush()
-        except (BrokenPipeError, ConnectionResetError):
+
+            if self.fail_watch > 0:
+                # injected transient failure (apiserver blip): the
+                # client must keep its resume point and re-watch, NOT
+                # relist (ADVICE r4 / kubecluster._loop)
+                self.fail_watch -= 1
+                write_line(
+                    {
+                        "type": "ERROR",
+                        "object": {
+                            "kind": "Status",
+                            "code": 500,
+                            "message": "injected watch failure",
+                        },
+                    }
+                )
+                return
+            def expired():
+                # the ERROR event a real apiserver streams on an
+                # expired resourceVersion (410 Gone) — also sent to a
+                # CONNECTED watcher the trimmed cache can no longer
+                # serve (a slow watcher must relist, never silently
+                # lose the trimmed events)
+                self.watch_410s += 1
+                write_line(
+                    {
+                        "type": "ERROR",
+                        "object": {
+                            "kind": "Status",
+                            "code": 410,
+                            "reason": "Expired",
+                            "message": "too old resource version",
+                        },
+                    }
+                )
+
+            if last_rv is not None and last_rv < self._min_rv:
+                expired()
+                return
+            deadline = time.monotonic() + min(timeout, 30.0)
+            next_bookmark = time.monotonic() + self.bookmark_interval
+            while time.monotonic() < deadline:
+                # head BEFORE the log scan: every rv <= head has its
+                # log entry appended (writes serialize rv assignment +
+                # append under _rv_lock), so advancing last_rv to head
+                # via a bookmark can never skip an in-flight event
+                with self._rv_lock:
+                    head = self._rv
+                if last_rv is None:
+                    last_rv = head  # live-only watch: start at head
+                with self._log_lock:
+                    if last_rv < self._min_rv:
+                        trimmed_under = True
+                        fresh = []
+                    else:
+                        trimmed_under = False
+                        fresh = sorted(
+                            (
+                                e
+                                for e in self._log
+                                if e[3] == key and e[0] > last_rv
+                            ),
+                            key=lambda e: e[0],
+                        )
+                if trimmed_under:
+                    expired()
+                    return
+                for rv, etype, obj, _k in fresh:
+                    write_line({"type": etype, "object": obj})
+                    last_rv = rv
+                if bookmarks and time.monotonic() >= next_bookmark:
+                    next_bookmark = (
+                        time.monotonic() + self.bookmark_interval
+                    )
+                    if head > last_rv:
+                        write_line(
+                            {
+                                "type": "BOOKMARK",
+                                "object": {
+                                    "kind": gvk.kind,
+                                    "metadata": {
+                                        "resourceVersion": str(head)
+                                    },
+                                },
+                            }
+                        )
+                        last_rv = head
+                time.sleep(0.05)
+        except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
-            unsub()
+            self._active_watches.discard(conn)
         try:
             h.wfile.flush()
             h.connection.close()
@@ -323,27 +475,30 @@ class MockApiServer:
             )
             if want_rv != have_rv:
                 return h._json(409, {"message": "conflict"})
-        meta["resourceVersion"] = self.next_rv()
         obj["metadata"] = meta
         obj.setdefault("apiVersion", gvk.api_version)
         obj.setdefault("kind", gvk.kind)
-        self.store.apply(obj)
-        return h._json(200 if method == "PUT" else 201, obj)
+        stamped = self._commit(
+            MODIFIED if existing is not None else ADDED, gvk, obj,
+            self.store.apply,
+        )
+        return h._json(200 if method == "PUT" else 201, stamped)
 
     def handle_delete(self, h):
         resolved = self._resolve(urlparse(h.path).path)
         if resolved is None:
             return h._json(404, {"message": "unknown path"})
         gvk, namespaced, ns, name = resolved
-        ok = self.store.delete(gvk, ns, name)
-        if not ok:
+        victim = self._exists(gvk, ns, name)
+        if victim is None and not ns:
             # cluster-scoped objects have no ns path component
-            for cand in self.store.list(gvk):
-                if (cand.get("metadata") or {}).get("name") == name:
-                    ok = self.store.delete(cand)
-                    break
-        if not ok:
+            victim = self._exists(gvk, "", name)
+        if victim is None:
             return h._json(404, {"message": "not found"})
+        self._commit(
+            DELETED, gvk, dict(victim),
+            lambda stamped: self.store.delete(victim),
+        )
         return h._json(200, {"status": "Success"})
 
 
@@ -443,13 +598,146 @@ def test_watch_streams_and_resyncs(mock):
         ):
             time.sleep(0.05)
         mock.seed(pod("w1", {"upd": "1"}))  # MODIFIED
-        mock.store.delete(pod("w1"))  # DELETED
+        mock.remove(pod("w1"))  # DELETED
         assert done.wait(10), got
     finally:
         unsub()
     types = [t for t, n in got if n == "w1"]
     assert types[0] == ADDED
     assert MODIFIED in types and DELETED in types
+
+
+def test_watch_resumes_from_bookmark_without_relist(mock):
+    """ADVICE r4: a CLEAN server-side watch close (the periodic timeout)
+    re-watches from the last bookmark rv — no O(corpus) relist per
+    cycle. Only the boot pass lists."""
+    mock.bookmark_interval = 0.1
+    kc = KubeCluster(base_url=mock.url, watch_timeout_seconds=1)
+    got = []
+    unsub = kc.subscribe(GVK("", "v1", "Pod"), lambda ev: got.append(ev))
+    try:
+        deadline = time.monotonic() + 10
+        mock.seed(pod("b1"))
+        while time.monotonic() < deadline and not got:
+            time.sleep(0.05)
+        assert got, "watch never delivered"
+        lists_after_boot = mock.list_requests
+        # ride through several clean 1s-timeout closes
+        time.sleep(3.0)
+        assert mock.list_requests == lists_after_boot, (
+            "clean close triggered a relist"
+        )
+        # events still flow on the resumed stream
+        mock.seed(pod("b2"))
+        while time.monotonic() < deadline and len(
+            {(e.obj.get("metadata") or {}).get("name") for e in got}
+        ) < 2:
+            time.sleep(0.05)
+        names = {(e.obj.get("metadata") or {}).get("name") for e in got}
+        assert names == {"b1", "b2"}
+        assert mock.list_requests == lists_after_boot
+    finally:
+        unsub()
+
+
+def test_watch_transient_error_keeps_resume_point(mock):
+    """A transient watch failure (injected 500) must NOT discard the
+    resume point; a genuinely expired rv (410 after log trim) must force
+    relist-and-diff, which reconverges without losing objects."""
+    mock.log_retention = 5
+    kc = KubeCluster(base_url=mock.url, watch_timeout_seconds=2)
+    got = []
+    unsub = kc.subscribe(GVK("", "v1", "Pod"), lambda ev: got.append(ev))
+    try:
+        deadline = time.monotonic() + 20
+        mock.seed(pod("t0"))
+        while time.monotonic() < deadline and not got:
+            time.sleep(0.05)
+        lists_after_boot = mock.list_requests
+        # blip: reject the next watch attempts; client should keep rv
+        mock.fail_watch = 2
+        mock.kill_watches()
+        # while the client backs off, trim its rv out of the log
+        for i in range(12):
+            mock.seed(pod(f"t{i + 1}"))
+        # convergence: every pod observed despite the 410 relist
+        want = {f"t{i}" for i in range(13)}
+        while time.monotonic() < deadline:
+            names = {
+                (e.obj.get("metadata") or {}).get("name") for e in got
+            }
+            if want <= names:
+                break
+            time.sleep(0.05)
+        assert want <= names, f"lost objects: {want - names}"
+        assert mock.watch_410s >= 1, "stale rv never rejected"
+        assert mock.list_requests > lists_after_boot, (
+            "410 did not trigger the relist"
+        )
+    finally:
+        unsub()
+
+
+def test_delayed_crd_establishment(mock):
+    """A subscription to a kind whose CRD is not yet served (404) must
+    retry and start delivering once the kind is established — the
+    constraint-kind watch registered at template ingest, before the CRD
+    controller creates the CRD (constrainttemplate_controller.go:458)."""
+    late = GVK("constraints.gatekeeper.sh", "v1beta1", "K8sLateKind")
+    kc = KubeCluster(base_url=mock.url, watch_timeout_seconds=2)
+    got = []
+    unsub = kc.subscribe(late, lambda ev: got.append(ev))
+    try:
+        time.sleep(0.5)  # a few 404 resync attempts
+        assert not got
+        mock.register(late, "k8slatekinds", False)
+        mock.seed(
+            {
+                "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": "K8sLateKind",
+                "metadata": {"name": "late-1"},
+                "spec": {},
+            }
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not got:
+            time.sleep(0.05)
+        assert [
+            (e.obj.get("metadata") or {}).get("name") for e in got
+        ] == ["late-1"]
+    finally:
+        unsub()
+
+
+def test_watch_chaos_kill_mid_stream_reconverges(mock):
+    """Chaos: sever the watch stream repeatedly while objects churn;
+    relist-and-diff must reconverge on the full set with no lost or
+    duplicated ADDED events (manager_integration_test.go's recovery
+    contract)."""
+    kc = KubeCluster(base_url=mock.url, watch_timeout_seconds=5)
+    got = []
+    unsub = kc.subscribe(GVK("", "v1", "Pod"), lambda ev: got.append(ev))
+    try:
+        deadline = time.monotonic() + 25
+        for i in range(15):
+            mock.seed(pod(f"c{i}"))
+            if i % 3 == 2:
+                mock.kill_watches()
+                time.sleep(0.05)
+        want = {f"c{i}" for i in range(15)}
+        while time.monotonic() < deadline:
+            added = [
+                (e.obj.get("metadata") or {}).get("name")
+                for e in got
+                if e.type == ADDED
+            ]
+            if want <= set(added):
+                break
+            time.sleep(0.05)
+        assert want <= set(added), f"lost: {want - set(added)}"
+        assert len(added) == len(set(added)), "duplicate ADDED events"
+    finally:
+        unsub()
 
 
 def test_apply_conflict_retry(mock):

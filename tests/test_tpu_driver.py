@@ -935,3 +935,142 @@ def test_uniqueserviceselector_pruned_render_parity():
     w = rego.review(new_svc).by_target[TARGET].results
     g = tpu.review(new_svc).by_target[TARGET].results
     assert canon(g) == canon(w) and len(w) > 0
+
+
+def test_serve_while_compiling_cold_route_then_swap():
+    """VERDICT r4 #4: a device-sized review batch arriving before the
+    fused path is compiled serves from the interpreter (correct results,
+    no blocking on compile) and kicks a background warm; once
+    warm_review_path completes the SAME batch takes the compiled route.
+    Template churn drops the route cold again."""
+    tdir = f"{LIB}/general/requiredlabels"
+    tpu_driver = TpuDriver()
+    clients = []
+    for drv in (RegoDriver(), tpu_driver):
+        cl = Backend(drv).new_client(K8sValidationTarget())
+        cl.add_template(load_template(tdir))
+        cl.add_constraint(
+            make_constraint(
+                "K8sRequiredLabels", "need-owner",
+                params={"labels": [{"key": "owner"}]},
+                match={"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+            )
+        )
+        clients.append(cl)
+    rego, tpu = clients
+    objs = [
+        AugmentedUnstructured(
+            pod(f"p{i}", labels={"owner": "me"} if i % 2 else None)
+        )
+        for i in range(16)
+    ]
+    assert not tpu_driver.review_path_warm(TARGET)
+    want = [r.by_target[TARGET].results for r in rego.review_many(objs)]
+    got = [r.by_target[TARGET].results for r in tpu.review_many(objs)]
+    assert [canon(g) for g in got] == [canon(w) for w in want]
+    assert sum(len(w) for w in want) == 8
+    assert tpu_driver.cold_batches == 1  # served cold, on the interpreter
+    # synchronous warm (what the webhook's background thread runs)
+    assert tpu.warm_review_path(objs)
+    assert tpu_driver.review_path_warm(TARGET)
+    got2 = [r.by_target[TARGET].results for r in tpu.review_many(objs)]
+    assert [canon(g) for g in got2] == [canon(w) for w in want]
+    assert tpu_driver.cold_batches == 1  # no new cold batch: fused route
+    assert tpu_driver.stats["compiled_pairs"] > 0
+    # a NOVEL shape bucket after the flag is warm must still not compile
+    # inline: it serves on the interpreter (ColdKernel fallback) and
+    # compiles in the background
+    big = [
+        AugmentedUnstructured(
+            pod(f"b{i}", labels={"owner": "me"} if i % 2 else None)
+        )
+        for i in range(96)
+    ]
+    got3 = [r.by_target[TARGET].results for r in tpu.review_many(big)]
+    assert sum(len(g) for g in got3) == 48
+    assert tpu_driver.cold_batches == 2  # bucket-cold, served interp
+    assert tpu.warm_review_path(big)
+    got4 = [r.by_target[TARGET].results for r in tpu.review_many(big)]
+    assert sum(len(g) for g in got4) == 48
+    assert tpu_driver.cold_batches == 2  # bucket now compiled
+    # template churn bumps the constraint generation -> cold again
+    tpu.add_constraint(
+        make_constraint(
+            "K8sRequiredLabels", "need-app",
+            params={"labels": [{"key": "app"}]},
+            match={"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+        )
+    )
+    assert not tpu_driver.review_path_warm(TARGET)
+
+
+def test_uniqueingresshost_pruned_render_parity():
+    """VERDICT r4 weak #5: the spec.rules[_].host PATH-key join renders
+    against a pruned inventory exactly like uniqueserviceselector's
+    fn-key join — O(candidates) per flagged ingress, multi-valued keys
+    (one per rule), bit-exact vs the full-inventory interpreter
+    (reference: library/general/uniqueingresshost/src.rego)."""
+    tdir = f"{LIB}/general/uniqueingresshost"
+
+    def ing(name, ns, hosts, group="networking.k8s.io"):
+        return {
+            "apiVersion": f"{group}/v1beta1",
+            "kind": "Ingress",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"rules": [{"host": h} for h in hosts]},
+        }
+
+    objs = (
+        # duplicate pairs across namespaces AND api groups; one ingress
+        # whose SECOND rule carries the duplicated host (multi-key)
+        [ing("a", "ns0", ["dup.example.com"])]
+        + [ing("b", "ns1", ["other.example.com", "dup.example.com"])]
+        + [ing("c", "ns1", ["x.example.com"], group="extensions")]
+        + [ing("d", "ns2", ["x.example.com"])]
+        + [ing(f"u{i}", f"ns{i % 3}", [f"solo{i}.example.com"])
+           for i in range(10)]
+        + [pod(f"pp{i}", ns=f"ns{i % 3}") for i in range(6)]
+    )
+    kinds_match = {
+        "kinds": [
+            {
+                "apiGroups": ["extensions", "networking.k8s.io"],
+                "kinds": ["Ingress"],
+            }
+        ]
+    }
+    tpu_driver = TpuDriver()
+    clients = []
+    for drv in (RegoDriver(), tpu_driver):
+        cl = Backend(drv).new_client(K8sValidationTarget())
+        cl.add_template(load_template(tdir))
+        cl.add_constraint(
+            make_constraint("K8sUniqueIngressHost", "uih", match=kinds_match)
+        )
+        for o in objs:
+            cl.add_data(o)
+        clients.append(cl)
+    rego, tpu = clients
+    want = rego.audit().by_target[TARGET].results
+    got = tpu.audit().by_target[TARGET].results
+    assert canon(got) == canon(want)
+    # a, b (via its second rule), c, d all conflict
+    assert len(want) >= 4
+    assert tpu_driver.stats["pruned_renders"] > 0, tpu_driver.stats
+    prog = tpu_driver._constraint_set(TARGET).programs[0]
+    assert prog.prune == {
+        "path": ("spec", "rules", "?", "host"),
+        "review_pattern": ("object", "spec", "rules", "#", "host"),
+        "tree": "namespace",
+    }
+    # the index maps each host to ONLY its carriers: the pruned render
+    # is O(candidates), not O(corpus)
+    kind = "K8sUniqueIngressHost"
+    index = tpu_driver._prune_index(TARGET, kind, None, prog.prune)
+    assert {len(v) for v in index.values()} <= {1, 2}
+    assert len(index["dup.example.com"]) == 2
+    # the webhook/review path prunes too
+    new_ing = AugmentedUnstructured(ing("new", "ns2", ["dup.example.com"]))
+    w = rego.review(new_ing).by_target[TARGET].results
+    g = tpu.review(new_ing).by_target[TARGET].results
+    assert canon(g) == canon(w) and len(w) > 0
