@@ -1,0 +1,473 @@
+"""Templated deployment manifests from one values source — the
+helm-chart equivalent (reference: charts/gatekeeper/ values.yaml +
+templates/; there is no helm binary in this toolchain, so the chart is a
+Python generator with the same knob surface).
+
+    python deploy/render.py                        # defaults -> stdout
+    python deploy/render.py --set replicas=3 --set image.tag=v0.2.0
+    python deploy/render.py --values my-values.yaml
+
+`deploy/gatekeeper-tpu.yaml` is the rendered DEFAULTS (kept in sync by
+tests/test_deploy_render.py); edit values here, not the output.
+
+Design notes carried over from the hand-written manifest:
+  * operations split (pkg/operations/operations.go:15-19): separate
+    webhook + audit Deployments, each holding full replicated policy
+    state; the audit pod schedules onto a TPU node (the fused sweep is
+    the throughput path), webhook pods are CPU replicas;
+  * webhook replicas default to 1: the cert rotator stores its CA in
+    the pod-local --cert-dir; scaling needs a SHARED cert store (the
+    reference keeps the pair in a Secret, certs.go:119-181) so all
+    replicas serve one CA;
+  * the compile-cache volume turns pod restarts into warm boots; Ready
+    gates on state replay only (serve-while-compiling), so a cold
+    cache degrades latency briefly, never availability;
+  * RBAC is a scoped ClusterRole (read-everything + CRUD on CRDs,
+    gatekeeper.sh groups, Events, the VWH, cert Secrets), mirroring
+    gatekeeper-manager-role — never cluster-admin (ADVICE r4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import sys
+from typing import Any, Dict, List
+
+import yaml
+
+DEFAULT_VALUES: Dict[str, Any] = {
+    "namespace": "gatekeeper-system",
+    "image": {
+        "repository": "gatekeeper-tpu",
+        "tag": "latest",
+        "pullPolicy": "IfNotPresent",
+    },
+    # webhook pods (CPU, latency path); see module docstring for the
+    # replicas=1 cert-store constraint
+    "replicas": 1,
+    "auditInterval": 60,
+    "constraintViolationsLimit": 20,
+    "auditFromCache": False,
+    "disableValidatingWebhook": False,
+    "logDenies": True,
+    "emitAdmissionEvents": True,
+    "emitAuditEvents": True,
+    # None -> [namespace]: gatekeeper's own namespace must stay exempt
+    # or a restrictive constraint can deny recreation of the webhook
+    # pod itself (self-deadlock)
+    "exemptNamespaces": None,
+    "webhookPort": 8443,
+    "healthPort": 9090,
+    "prometheusPort": 8888,
+    "webhookTimeoutSeconds": 3,
+    # fail-open (policy.go:80): audit is the backstop
+    "webhookFailurePolicy": "Ignore",
+    "vwhName": "gatekeeper-validating-webhook-configuration",
+    "minDeviceBatch": None,  # GATEKEEPER_TPU_MIN_DEVICE_BATCH override
+    "nodeSelector": {},  # webhook pods
+    "tolerations": [],
+    "resources": {},  # webhook container resources
+    "audit": {
+        # one replica on a TPU node: the 100k x 500 fused sweep
+        "nodeSelector": {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "1x1",
+        },
+        "tolerations": [],
+        "resources": {"limits": {"google.com/tpu": "1"}},
+    },
+    # emptyDir by default; set to a PVC claim name for persistent warm
+    # XLA compile caches across pod restarts
+    "compileCachePVC": None,
+}
+
+
+def _merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _set_path(values: Dict[str, Any], dotted: str, raw: str) -> None:
+    node = values
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = yaml.safe_load(raw)
+
+
+def _cache_volume(v):
+    if v["compileCachePVC"]:
+        return {
+            "name": "xla-cache",
+            "persistentVolumeClaim": {"claimName": v["compileCachePVC"]},
+        }
+    return {"name": "xla-cache", "emptyDir": {}}
+
+
+def _container(v, name: str, args: List[str]):
+    env = [
+        {
+            "name": "POD_NAME",
+            "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}},
+        }
+    ]
+    if v["minDeviceBatch"] is not None:
+        env.append(
+            {
+                "name": "GATEKEEPER_TPU_MIN_DEVICE_BATCH",
+                "value": str(v["minDeviceBatch"]),
+            }
+        )
+    return {
+        "name": name,
+        "image": f"{v['image']['repository']}:{v['image']['tag']}",
+        "imagePullPolicy": v["image"]["pullPolicy"],
+        "args": args,
+        "env": env,
+    }
+
+
+def _deployment(v, name: str, operation: str, spec_pod: Dict[str, Any],
+                replicas: int):
+    labels = {"gatekeeper.sh/operation": operation}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": v["namespace"]},
+        "spec": {
+            "replicas": replicas,
+            # distinct dicts: a shared reference makes the YAML dumper
+            # emit anchors/aliases that confuse downstream tooling
+            "selector": {"matchLabels": dict(labels)},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "serviceAccountName": "gatekeeper-admin",
+                    **spec_pod,
+                },
+            },
+        },
+    }
+
+
+def _crd(group: str, kind: str, plural: str, scope: str,
+         versions: List[str]):
+    """Structural CRD with an open schema — the framework validates
+    content itself (constraint-kind CRDs are created at runtime by the
+    template controller; these are the base CRDs the chart ships,
+    charts/gatekeeper/templates/*-customresourcedefinition.yaml)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{group}"},
+        "spec": {
+            "group": group,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": kind.lower(),
+            },
+            "scope": scope,
+            "versions": [
+                {
+                    "name": ver,
+                    "served": True,
+                    "storage": ver == versions[0],
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        }
+                    },
+                    "subresources": {"status": {}},
+                }
+                for ver in versions
+            ],
+        },
+    }
+
+
+def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
+    """Values -> list of manifest documents."""
+    v = _merge(DEFAULT_VALUES, values or {})
+    ns = v["namespace"]
+
+    docs: List[Dict[str, Any]] = [
+        _crd("templates.gatekeeper.sh", "ConstraintTemplate",
+             "constrainttemplates", "Cluster", ["v1beta1", "v1alpha1"]),
+        _crd("config.gatekeeper.sh", "Config", "configs", "Namespaced",
+             ["v1alpha1"]),
+        _crd("status.gatekeeper.sh", "ConstraintPodStatus",
+             "constraintpodstatuses", "Namespaced", ["v1beta1"]),
+        _crd("status.gatekeeper.sh", "ConstraintTemplatePodStatus",
+             "constrainttemplatepodstatuses", "Namespaced", ["v1beta1"]),
+        {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": ns},
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": "gatekeeper-admin", "namespace": ns},
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "gatekeeper-tpu-manager-role"},
+            "rules": [
+                # audit's discovery-list mode + config sync watch every
+                # listable kind
+                {
+                    "apiGroups": ["*"],
+                    "resources": ["*"],
+                    "verbs": ["get", "list", "watch"],
+                },
+                {
+                    "apiGroups": ["apiextensions.k8s.io"],
+                    "resources": ["customresourcedefinitions"],
+                    "verbs": ["create", "delete", "get", "list", "patch",
+                              "update", "watch"],
+                },
+                {
+                    "apiGroups": [
+                        "config.gatekeeper.sh",
+                        "constraints.gatekeeper.sh",
+                        "templates.gatekeeper.sh",
+                        "status.gatekeeper.sh",
+                    ],
+                    "resources": ["*"],
+                    "verbs": ["create", "delete", "get", "list", "patch",
+                              "update", "watch"],
+                },
+                {
+                    "apiGroups": [""],
+                    "resources": ["events"],
+                    "verbs": ["create", "patch", "update", "get"],
+                },
+                {
+                    "apiGroups": [""],
+                    "resources": ["secrets"],
+                    "verbs": ["create", "delete", "get", "list", "patch",
+                              "update", "watch"],
+                },
+                {
+                    "apiGroups": ["admissionregistration.k8s.io"],
+                    "resources": ["validatingwebhookconfigurations"],
+                    "verbs": ["create", "get", "list", "patch", "update",
+                              "watch"],
+                },
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "gatekeeper-admin"},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "gatekeeper-tpu-manager-role",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": "gatekeeper-admin",
+                    "namespace": ns,
+                }
+            ],
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": "gatekeeper-webhook-service",
+                "namespace": ns,
+            },
+            "spec": {
+                "selector": {"gatekeeper.sh/operation": "webhook"},
+                "ports": [{"port": 443, "targetPort": v["webhookPort"]}],
+            },
+        },
+    ]
+
+    webhook_args = [
+        "--operation=webhook",
+        "--operation=status",
+        f"--port={v['webhookPort']}",
+        f"--health-addr-port={v['healthPort']}",
+        f"--prometheus-port={v['prometheusPort']}",
+    ]
+    if v["logDenies"]:
+        webhook_args.append("--log-denies")
+    if v["emitAdmissionEvents"]:
+        webhook_args.append("--emit-admission-events")
+    if not v["disableValidatingWebhook"]:
+        webhook_args.append(f"--vwh-name={v['vwhName']}")
+    exempt = v["exemptNamespaces"]
+    if exempt is None:
+        exempt = [ns]
+    webhook_args += [f"--exempt-namespace={e}" for e in exempt]
+    webhook_ctr = _container(v, "webhook", webhook_args)
+    webhook_ctr["ports"] = [{"containerPort": v["webhookPort"]}]
+    webhook_ctr["readinessProbe"] = {
+        "httpGet": {"path": "/readyz", "port": v["healthPort"]},
+        "periodSeconds": 5,
+        "failureThreshold": 12,
+    }
+    webhook_ctr["volumeMounts"] = [
+        {"name": "certs", "mountPath": "/certs"},
+        {"name": "xla-cache", "mountPath": "/cache"},
+    ]
+    if v["resources"]:
+        webhook_ctr["resources"] = v["resources"]
+    webhook_pod: Dict[str, Any] = {
+        "containers": [webhook_ctr],
+        "volumes": [
+            {"name": "certs", "emptyDir": {}},
+            _cache_volume(v),
+        ],
+    }
+    if v["nodeSelector"]:
+        webhook_pod["nodeSelector"] = v["nodeSelector"]
+    if v["tolerations"]:
+        webhook_pod["tolerations"] = v["tolerations"]
+    docs.append(
+        _deployment(v, "gatekeeper-webhook", "webhook", webhook_pod,
+                    v["replicas"])
+    )
+
+    audit_args = [
+        "--operation=audit",
+        "--operation=status",
+        f"--health-addr-port={v['healthPort']}",
+        f"--prometheus-port={v['prometheusPort']}",
+        f"--audit-interval={v['auditInterval']}",
+        f"--constraint-violations-limit={v['constraintViolationsLimit']}",
+    ]
+    if v["auditFromCache"]:
+        audit_args.append("--audit-from-cache")
+    if v["emitAuditEvents"]:
+        audit_args.append("--emit-audit-events")
+    audit_ctr = _container(v, "audit", audit_args)
+    audit_ctr["resources"] = v["audit"]["resources"]
+    audit_ctr["readinessProbe"] = {
+        "httpGet": {"path": "/readyz", "port": v["healthPort"]},
+        "periodSeconds": 10,
+        "failureThreshold": 60,
+    }
+    audit_ctr["volumeMounts"] = [
+        {"name": "xla-cache", "mountPath": "/cache"},
+    ]
+    audit_pod: Dict[str, Any] = {
+        "containers": [audit_ctr],
+        "volumes": [_cache_volume(v)],
+    }
+    if v["audit"]["nodeSelector"]:
+        audit_pod["nodeSelector"] = v["audit"]["nodeSelector"]
+    if v["audit"]["tolerations"]:
+        audit_pod["tolerations"] = v["audit"]["tolerations"]
+    docs.append(
+        _deployment(v, "gatekeeper-audit", "audit", audit_pod, 1)
+    )
+
+    if not v["disableValidatingWebhook"]:
+        docs.append(
+            {
+                "apiVersion": "admissionregistration.k8s.io/v1",
+                "kind": "ValidatingWebhookConfiguration",
+                "metadata": {"name": v["vwhName"]},
+                "webhooks": [
+                    {
+                        "name": "validation.gatekeeper.sh",
+                        "admissionReviewVersions": ["v1"],
+                        "sideEffects": "None",
+                        "failurePolicy": v["webhookFailurePolicy"],
+                        "timeoutSeconds": v["webhookTimeoutSeconds"],
+                        "clientConfig": {
+                            # caBundle injected + self-healed by the
+                            # running pods (--vwh-name, CaBundleInjector)
+                            "service": {
+                                "name": "gatekeeper-webhook-service",
+                                "namespace": ns,
+                                "path": "/v1/admit",
+                            }
+                        },
+                        "rules": [
+                            {
+                                "apiGroups": ["*"],
+                                "apiVersions": ["*"],
+                                "operations": ["CREATE", "UPDATE"],
+                                "resources": ["*"],
+                            }
+                        ],
+                    },
+                    {
+                        "name": "check-ignore-label.gatekeeper.sh",
+                        "admissionReviewVersions": ["v1"],
+                        "sideEffects": "None",
+                        "failurePolicy": "Fail",
+                        "clientConfig": {
+                            "service": {
+                                "name": "gatekeeper-webhook-service",
+                                "namespace": ns,
+                                "path": "/v1/admitlabel",
+                            }
+                        },
+                        "rules": [
+                            {
+                                "apiGroups": [""],
+                                "apiVersions": ["*"],
+                                "operations": ["CREATE", "UPDATE"],
+                                "resources": ["namespaces"],
+                            }
+                        ],
+                    },
+                ],
+            }
+        )
+    return [copy.deepcopy(d) for d in docs]
+
+
+HEADER = """\
+# GENERATED by deploy/render.py — edit values there, not this file.
+# The operations-split deployment (webhook CPU replicas + one audit pod
+# on a TPU node), scoped RBAC, base CRDs, Service, and the fail-open
+# ValidatingWebhookConfiguration. See deploy/render.py's docstring for
+# the design rationale and charts/gatekeeper parity notes.
+"""
+
+
+def render_text(values: Dict[str, Any] | None = None) -> str:
+    return HEADER + yaml.safe_dump_all(
+        render(values), sort_keys=False, default_flow_style=False
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="render.py", description=__doc__)
+    p.add_argument("--values", help="YAML values file merged over defaults")
+    p.add_argument(
+        "--set", action="append", default=[],
+        help="dotted override, e.g. --set image.tag=v0.2.0",
+    )
+    args = p.parse_args(argv)
+    values: Dict[str, Any] = {}
+    if args.values:
+        with open(args.values) as f:
+            values = yaml.safe_load(f) or {}
+    for item in args.set:
+        key, _, raw = item.partition("=")
+        _set_path(values, key, raw)
+    sys.stdout.write(render_text(values))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

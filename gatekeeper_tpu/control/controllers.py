@@ -122,6 +122,9 @@ class TemplateController:
                 template_name=name,
             )
         if self.metrics is not None:
+            self.metrics.record(
+                "constraint_template_ingestion_count", 1, status=status
+            )
             self.metrics.observe(
                 "constraint_template_ingestion_duration_seconds",
                 time.perf_counter() - t0,

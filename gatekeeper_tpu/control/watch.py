@@ -131,9 +131,13 @@ class WatchManager:
 
     def _report(self) -> None:
         if self.metrics is not None:
-            self.metrics.gauge(
-                "watch_manager_watched_gvk", len(self.watched_gvks())
-            )
+            n = len(self.watched_gvks())
+            self.metrics.gauge("watch_manager_watched_gvk", n)
+            # intended == watched here: _add_watch starts subscriptions
+            # synchronously, so there is no requested-but-not-running
+            # gap (the reference tracks the two separately because its
+            # informer creation is async, watch/stats_reporter.go)
+            self.metrics.gauge("watch_manager_intended_watch_gvk", n)
 
     # -- event distribution -----------------------------------------------------
 

@@ -142,11 +142,12 @@ class MetricsRegistry:
 
 
 def serve_metrics(
-    registry: MetricsRegistry, port: int = 0
+    registry: MetricsRegistry, port: int = 0, bind_addr: str = "127.0.0.1"
 ) -> ThreadingHTTPServer:
     """Serve /metrics (Prometheus text) on a background thread; returns
     the server (server_address[1] carries the bound port). The reference
-    serves the same on --prometheus-port 8888."""
+    serves the same on --prometheus-port 8888; in-cluster runs bind
+    0.0.0.0 so Prometheus can scrape the pod IP (run.py wires this)."""
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802
@@ -164,7 +165,7 @@ def serve_metrics(
         def log_message(self, *args):
             pass
 
-    httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    httpd = ThreadingHTTPServer((bind_addr, port), _Handler)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     return httpd

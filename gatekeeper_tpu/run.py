@@ -8,6 +8,7 @@ with the selected operations; flags mirror the reference's surface:
   --operation            webhook|audit|status (repeatable; default all)
   --port                 webhook HTTPS port (policy.go:73)
   --health-addr-port     readyz/healthz port (main.go:87)
+  --prometheus-port      /metrics exposition port (exporter.go:26)
   --audit-interval       seconds between sweeps (audit/manager.go:48)
   --audit-from-cache     sweep the synced cache instead of listing
   --constraint-violations-limit  per-constraint cap (manager.go:49)
@@ -35,6 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["webhook", "audit", "status"])
     p.add_argument("--port", type=int, default=8443)
     p.add_argument("--health-addr-port", type=int, default=9090)
+    # Prometheus exposition (exporter.go:26 / --prometheus-port 8888 in
+    # the reference); 0 disables
+    p.add_argument("--prometheus-port", type=int, default=8888)
     p.add_argument("--audit-interval", type=float, default=60.0)
     p.add_argument("--audit-from-cache", action="store_true")
     p.add_argument("--constraint-violations-limit", type=int, default=20)
@@ -116,6 +120,15 @@ def main(argv=None) -> int:
     )
     runner.start()
 
+    metrics_httpd = None
+    if args.prometheus_port:
+        from .metrics import serve_metrics
+
+        metrics_httpd = serve_metrics(
+            runner.metrics, port=args.prometheus_port, bind_addr="0.0.0.0"
+        )
+        log.info("metrics serving", prometheus_port=args.prometheus_port)
+
     stop = threading.Event()
 
     def _sig(signum, frame):
@@ -125,6 +138,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
     stop.wait()
+    if metrics_httpd is not None:
+        metrics_httpd.shutdown()
     runner.stop()
     cluster.stop()
     return 0

@@ -212,6 +212,52 @@ def test_boot_to_ready_and_audit(booted):
     assert resp.status == 200 and body["ready"] is True
 
 
+def test_metric_contract_surface(booted):
+    """docs/metrics.md contract: every documented Prometheus series
+    exists after boot + one admission + one sweep (the reference's
+    docs/Metrics.md enumerates the same names)."""
+    cluster, runner = booted
+    audit_results(runner)  # one sweep
+    resp = runner.webhook.handler.handle(
+        {
+            "uid": "m1",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "operation": "CREATE",
+            "name": "mpod",
+            "namespace": "default",
+            "userInfo": {"username": "dev"},
+            "object": pod("mpod"),
+        }
+    )
+    assert resp.allowed is False
+    text = runner.metrics.prometheus_text()
+    for name in (
+        "gatekeeper_constraints",
+        "gatekeeper_constraint_templates",
+        "gatekeeper_constraint_template_ingestion_count",
+        "gatekeeper_constraint_template_ingestion_duration_seconds",
+        "gatekeeper_request_count",
+        "gatekeeper_request_duration_seconds",
+        "gatekeeper_violations",
+        "gatekeeper_audit_duration_seconds",
+        "gatekeeper_audit_last_run_time",
+        "gatekeeper_sync",
+        "gatekeeper_sync_duration_seconds",
+        "gatekeeper_sync_last_run_time",
+        "gatekeeper_sync_gvk_count",
+        "gatekeeper_watch_manager_watched_gvk",
+        "gatekeeper_watch_manager_intended_watch_gvk",
+    ):
+        # boundary match: a deleted gatekeeper_sync counter must not be
+        # satisfied by its gatekeeper_sync_duration_seconds sibling;
+        # distributions expose name_count/name_sum series
+        assert any(
+            line.startswith(series + "{") or line.startswith(series + " ")
+            for line in text.splitlines()
+            for series in (name, name + "_count", name + "_sum")
+        ), f"missing documented metric {name}"
+
+
 def test_webhook_serves_from_ingested_state(booted):
     cluster, runner = booted
     req = {

@@ -1,0 +1,143 @@
+"""Deployment-manifest generator (deploy/render.py) — the helm-chart
+equivalent (reference charts/gatekeeper/: values.yaml + templates).
+Pins: the checked-in manifest is the rendered defaults, the knob surface
+propagates, RBAC stays scoped, and the VWH can be disabled."""
+
+import os
+import sys
+
+import yaml
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deploy",
+    ),
+)
+
+import render  # noqa: E402
+
+
+def kinds(docs):
+    return [d["kind"] for d in docs]
+
+
+def by_kind(docs, kind):
+    return [d for d in docs if d["kind"] == kind]
+
+
+def test_checked_in_manifest_is_rendered_defaults():
+    """deploy/gatekeeper-tpu.yaml is GENERATED: one source of truth."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(render.__file__)),
+        "gatekeeper-tpu.yaml",
+    )
+    with open(path) as f:
+        assert f.read() == render.render_text()
+
+
+def test_default_render_shape():
+    docs = render.render()
+    ks = kinds(docs)
+    assert ks.count("CustomResourceDefinition") == 4
+    for k in (
+        "Namespace",
+        "ServiceAccount",
+        "ClusterRole",
+        "ClusterRoleBinding",
+        "Service",
+        "ValidatingWebhookConfiguration",
+    ):
+        assert ks.count(k) == 1, k
+    assert ks.count("Deployment") == 2
+    # scoped RBAC, never cluster-admin (ADVICE r4)
+    crb = by_kind(docs, "ClusterRoleBinding")[0]
+    assert crb["roleRef"]["name"] == "gatekeeper-tpu-manager-role"
+    role = by_kind(docs, "ClusterRole")[0]
+    wildcard = [
+        r for r in role["rules"] if r["apiGroups"] == ["*"]
+    ]
+    assert wildcard and set(wildcard[0]["verbs"]) == {
+        "get", "list", "watch"
+    }, "wildcard apiGroup must be read-only"
+    # operations split: webhook + audit pods with the right roles
+    deps = {d["metadata"]["name"]: d for d in by_kind(docs, "Deployment")}
+    w_args = deps["gatekeeper-webhook"]["spec"]["template"]["spec"][
+        "containers"
+    ][0]["args"]
+    a_args = deps["gatekeeper-audit"]["spec"]["template"]["spec"][
+        "containers"
+    ][0]["args"]
+    assert "--operation=webhook" in w_args
+    assert "--operation=audit" in a_args
+    assert "--audit-interval=60" in a_args
+    assert "--constraint-violations-limit=20" in a_args
+    assert any(a.startswith("--prometheus-port=") for a in w_args)
+    # audit schedules on the TPU node with a chip
+    a_spec = deps["gatekeeper-audit"]["spec"]["template"]["spec"]
+    assert "tpu" in str(a_spec["nodeSelector"]).lower()
+    assert a_spec["containers"][0]["resources"]["limits"][
+        "google.com/tpu"
+    ] == "1"
+    # fail-open admission, fail-closed label guard (policy.go:80 /
+    # namespacelabel.go)
+    vwh = by_kind(docs, "ValidatingWebhookConfiguration")[0]
+    admit = {w["name"]: w for w in vwh["webhooks"]}
+    assert admit["validation.gatekeeper.sh"]["failurePolicy"] == "Ignore"
+    assert (
+        admit["check-ignore-label.gatekeeper.sh"]["failurePolicy"]
+        == "Fail"
+    )
+
+
+def test_values_propagate():
+    docs = render.render(
+        {
+            "replicas": 3,
+            "image": {"repository": "example.com/gk", "tag": "v9"},
+            "auditInterval": 120,
+            "auditFromCache": True,
+            "minDeviceBatch": 24,
+            "compileCachePVC": "warm-cache",
+            "namespace": "gk-sys",
+        }
+    )
+    deps = {d["metadata"]["name"]: d for d in by_kind(docs, "Deployment")}
+    web = deps["gatekeeper-webhook"]
+    assert web["spec"]["replicas"] == 3
+    assert web["metadata"]["namespace"] == "gk-sys"
+    ctr = web["spec"]["template"]["spec"]["containers"][0]
+    assert ctr["image"] == "example.com/gk:v9"
+    assert {"name": "GATEKEEPER_TPU_MIN_DEVICE_BATCH", "value": "24"} in (
+        ctr["env"]
+    )
+    aud = deps["gatekeeper-audit"]
+    a_args = aud["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--audit-interval=120" in a_args
+    assert "--audit-from-cache" in a_args
+    vols = aud["spec"]["template"]["spec"]["volumes"]
+    assert {"name": "xla-cache",
+            "persistentVolumeClaim": {"claimName": "warm-cache"}} in vols
+
+
+def test_disable_validating_webhook():
+    docs = render.render({"disableValidatingWebhook": True})
+    assert not by_kind(docs, "ValidatingWebhookConfiguration")
+    deps = {d["metadata"]["name"]: d for d in by_kind(docs, "Deployment")}
+    w_args = deps["gatekeeper-webhook"]["spec"]["template"]["spec"][
+        "containers"
+    ][0]["args"]
+    assert not any(a.startswith("--vwh-name") for a in w_args)
+
+
+def test_cli_set_overrides(capsys):
+    render.main(["--set", "replicas=5", "--set", "image.tag=v2"])
+    out = capsys.readouterr().out
+    docs = list(yaml.safe_load_all(out))
+    deps = {d["metadata"]["name"]: d for d in by_kind(docs, "Deployment")}
+    assert deps["gatekeeper-webhook"]["spec"]["replicas"] == 5
+    ctr = deps["gatekeeper-webhook"]["spec"]["template"]["spec"][
+        "containers"
+    ][0]
+    assert ctr["image"].endswith(":v2")
