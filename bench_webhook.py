@@ -174,6 +174,19 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
         "p50_ms": round(cpu_wall / cpu_n * 1e3, 2),
     }
     print(f"webhook cpu baseline (python interp): {cpu}", file=err)
+    # same interp handler under the measured concurrencies, so the
+    # fused-vs-interp CROSSOVER is computed like-for-like (VERDICT r4
+    # #2: the concurrency where the fused path starts winning)
+    interp_by_conc = {}
+    for conc in (8, 128):
+        n_sub = min(600, n_requests)
+        r = replay(cpu_handler, cpu_reqs * (n_sub // cpu_n + 1), conc)
+        interp_by_conc[conc] = r["throughput_rps"]
+        print(
+            f"webhook interp concurrent: c={conc} "
+            f"rps={r['throughput_rps']} p50={r['p50_ms']}ms",
+            file=err,
+        )
 
     client = build_webhook_client(TpuDriver(), n_constraints)
     batcher = MicroBatcher(client, TARGET, window_ms=2.0)
@@ -231,11 +244,36 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
     finally:
         batcher.stop()
     bridge = run_bridge_bench(n_requests, n_constraints, err=err)
-    return {
+    # explicit crossover: the lowest measured concurrency where the
+    # fused device path out-serves the per-request interpreter (below
+    # it, MIN_DEVICE_BATCH adaptive routing keeps admission on the
+    # interpreter deliberately)
+    crossover = None
+    for conc in sorted(interp_by_conc):
+        fused_rps = next(
+            (
+                r["throughput_rps"]
+                for r in out
+                if r["violating"] and r["concurrency"] == conc
+            ),
+            None,
+        )
+        if fused_rps is not None and fused_rps > interp_by_conc[conc]:
+            crossover = conc
+            break
+    result = {
         "cpu_python_interp": cpu,
+        "interp_rps_by_concurrency": interp_by_conc,
+        "fused_vs_interp_crossover_concurrency": crossover,
         "tpu_batched": out,
         "tpu_bridge": bridge,
     }
+    print(
+        f"fused-vs-interp crossover concurrency: {crossover} "
+        f"(interp rps {interp_by_conc})",
+        file=err,
+    )
+    return result
 
 
 # the reference harness's constraint-count ladder
