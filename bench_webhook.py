@@ -178,9 +178,10 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
     # fused-vs-interp CROSSOVER is computed like-for-like (VERDICT r4
     # #2: the concurrency where the fused path starts winning)
     interp_by_conc = {}
+    n_sub = min(600, n_requests)
+    interp_reqs = (cpu_reqs * -(-n_sub // cpu_n))[:n_sub]
     for conc in (8, 128):
-        n_sub = min(600, n_requests)
-        r = replay(cpu_handler, cpu_reqs * (n_sub // cpu_n + 1), conc)
+        r = replay(cpu_handler, interp_reqs, conc)
         interp_by_conc[conc] = r["throughput_rps"]
         print(
             f"webhook interp concurrent: c={conc} "

@@ -307,12 +307,23 @@ class AuditManager:
         ns_gvk = GVK("", "v1", "Namespace")
         ns_cache: Dict[str, Any] = {}  # per-sweep (nsCache, manager.go:299)
         results: List[Any] = []
+        list_pages = getattr(self.cluster, "list_pages", None)
         for gvk in sorted(self.cluster.known_gvks()):
             if gvk.group in skip_groups:
                 continue
-            objs = self.cluster.list(gvk)
-            for start in range(0, len(objs), self.audit_chunk_size):
-                chunk = objs[start : start + self.audit_chunk_size]
+            if list_pages is not None:
+                # stream apiserver pages at --audit-chunk-size: bounded
+                # memory per kind (the reference's paged List w/
+                # Continue, manager.go:277-298), one fused review_many
+                # dispatch per page
+                pages = list_pages(gvk, self.audit_chunk_size)
+            else:
+                objs = self.cluster.list(gvk)
+                pages = (
+                    objs[start : start + self.audit_chunk_size]
+                    for start in range(0, len(objs), self.audit_chunk_size)
+                )
+            for chunk in pages:
                 reviews = []
                 for obj in chunk:
                     ns = (obj.get("metadata") or {}).get("namespace") or ""

@@ -233,6 +233,40 @@ class KubeCluster(EventSource):
                 return []
             raise
 
+    def list_pages(self, gvk: GVK, limit: int):
+        """Stream the collection page by page at the given limit —
+        bounded memory for huge kinds (the reference's paged audit
+        listing, --audit-chunk-size + client.List w/ Continue,
+        audit/manager.go:277-298). Yields lists of items."""
+        try:
+            path, _ = self._gvk_path(gvk)
+        except KubeError as e:
+            if e.code in (403, 404):
+                return  # kind not (yet) served
+            raise
+        cont = ""
+        while True:
+            qs = f"?limit={limit}"
+            if cont:
+                from urllib.parse import quote
+
+                qs += f"&continue={quote(cont)}"
+            try:
+                doc = self._request("GET", path + qs)
+            except KubeError as e:
+                if e.code in (403, 404):
+                    return
+                raise
+            items = doc.get("items") or []
+            for it in items:
+                it.setdefault("apiVersion", gvk.api_version)
+                it.setdefault("kind", gvk.kind)
+            if items:
+                yield items
+            cont = (doc.get("metadata") or {}).get("continue") or ""
+            if not cont:
+                return
+
     def _collection_path(self, gvk: GVK, namespace: str = "") -> str:
         """Collection path, namespaced when the kind is and a namespace
         is given (/api/v1/namespaces/<ns>/pods vs /api/v1/pods)."""

@@ -62,6 +62,9 @@ class Runner:
         pod_name: str = "gatekeeper-pod",
         metrics=None,
         audit_interval: float = 60.0,
+        # --audit-chunk-size (manager.go:50): page size for the
+        # discovery-list sweep's batched reviews
+        audit_chunk_size: int = 512,
         webhook_port: int = 0,
         readyz_port: Optional[int] = 0,  # None disables the endpoint
         exempt_namespaces: Sequence[str] = (),
@@ -106,6 +109,7 @@ class Runner:
         )
         self.status_agg = StatusAggregator()
         self.audit_interval = audit_interval
+        self.audit_chunk_size = audit_chunk_size
         self.audit_from_cache = audit_from_cache
         # --enable-pprof equivalent (main.go:89-90,111-117): when on,
         # the readyz server also exposes /debug/profile?seconds=N which
@@ -314,6 +318,7 @@ class Runner:
                 self.client,
                 self.target,
                 audit_interval=self.audit_interval,
+                audit_chunk_size=self.audit_chunk_size,
                 metrics=self.metrics,
                 event_sink=self._emit_event,
                 emit_audit_events=self.emit_audit_events,

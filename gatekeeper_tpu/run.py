@@ -11,6 +11,7 @@ with the selected operations; flags mirror the reference's surface:
   --prometheus-port      /metrics exposition port (exporter.go:26)
   --audit-interval       seconds between sweeps (audit/manager.go:48)
   --audit-from-cache     sweep the synced cache instead of listing
+  --audit-chunk-size     discovery-sweep review batch size (manager.go:50)
   --constraint-violations-limit  per-constraint cap (manager.go:49)
   --log-denies           structured deny logs (policy.go:73)
   --emit-admission-events / --emit-audit-events
@@ -41,6 +42,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prometheus-port", type=int, default=8888)
     p.add_argument("--audit-interval", type=float, default=60.0)
     p.add_argument("--audit-from-cache", action="store_true")
+    p.add_argument("--audit-chunk-size", type=int, default=512)
     p.add_argument("--constraint-violations-limit", type=int, default=20)
     p.add_argument("--log-denies", action="store_true")
     p.add_argument("--emit-admission-events", action="store_true")
@@ -94,6 +96,7 @@ def build_runner(args, log=None, webhook_tls: bool = True):
         emit_admission_events=args.emit_admission_events,
         emit_audit_events=args.emit_audit_events,
         audit_from_cache=args.audit_from_cache,
+        audit_chunk_size=args.audit_chunk_size,
         enable_profiler=args.enable_pprof,
         log_denies=args.log_denies,
         logger=log,

@@ -168,6 +168,26 @@ def rand_service(rng, i):
 
 
 def rand_ingress(rng, i):
+    # structural edge cases stress the path-form prune collector
+    # (spec.rules[_].host): hostless rules, empty rule lists, and a
+    # missing spec entirely must neither crash nor change results
+    r = rng.random()
+    if r < 0.1:
+        spec = {}
+    elif r < 0.2:
+        spec = {"rules": []}
+    else:
+        spec = {
+            "rules": [
+                (
+                    {"host": rng.choice(["a.example.com", "b.example.com",
+                                         "c.example.com"])}
+                    if rng.random() < 0.85
+                    else {"http": {}}  # rule without a host
+                )
+                for _ in range(rng.randrange(1, 3))
+            ]
+        }
     return {
         "apiVersion": "extensions/v1beta1",
         "kind": "Ingress",
@@ -175,13 +195,7 @@ def rand_ingress(rng, i):
             "name": f"ing{i}",
             "namespace": rng.choice(["default", "prod"]),
         },
-        "spec": {
-            "rules": [
-                {"host": rng.choice(["a.example.com", "b.example.com",
-                                     "c.example.com"])}
-                for _ in range(rng.randrange(1, 3))
-            ]
-        },
+        "spec": spec,
     }
 
 
