@@ -141,7 +141,9 @@ class AuditManager:
         self.target = target
         self.audit_from_cache = audit_from_cache
         self.cluster = cluster
-        self.audit_chunk_size = audit_chunk_size
+        # clamp: 0 would mean "no limit" on the wire (unbounded page)
+        # and a zero range-step in the fallback chunker
+        self.audit_chunk_size = max(1, int(audit_chunk_size))
         self.excluder = excluder
         self.sink = sink if sink is not None else InMemorySink()
         self.audit_interval = audit_interval
@@ -293,8 +295,6 @@ class AuditManager:
         audit-chunk-size batches (each batch is one fused device
         dispatch via review_many; the reference issues one interpreted
         query per object here)."""
-        from ..constraint import AugmentedUnstructured
-
         skip_groups = {
             "constraints.gatekeeper.sh",
             "templates.gatekeeper.sh",
@@ -302,7 +302,6 @@ class AuditManager:
             "status.gatekeeper.sh",
         }
         from ..control.events import GVK
-        from ..control.process import PROCESS_AUDIT
 
         ns_gvk = GVK("", "v1", "Namespace")
         ns_cache: Dict[str, Any] = {}  # per-sweep (nsCache, manager.go:299)
@@ -323,40 +322,67 @@ class AuditManager:
                     objs[start : start + self.audit_chunk_size]
                     for start in range(0, len(objs), self.audit_chunk_size)
                 )
-            for chunk in pages:
-                reviews = []
-                for obj in chunk:
-                    ns = (obj.get("metadata") or {}).get("namespace") or ""
-                    if (
-                        ns
-                        and self.excluder is not None
-                        and self.excluder.is_namespace_excluded(
-                            PROCESS_AUDIT, ns
-                        )
-                    ):
-                        continue
-                    # attach the Namespace object (the reference's
-                    # nsCache.Get, manager.go:299-317) — without it the
-                    # review carries no namespace and every constraint-
-                    # level namespace match degrades to cluster-scoped.
-                    # A namespaced object whose Namespace is missing is
-                    # SKIPPED like the reference's lookup-failure path
-                    # (manager.go:307-311 logs and continues).
-                    if ns:
-                        if ns not in ns_cache:
-                            ns_cache[ns] = self.cluster.get(ns_gvk, "", ns)
-                        ns_obj = ns_cache[ns]
-                        if ns_obj is None:
-                            continue
-                        reviews.append(AugmentedUnstructured(obj, ns_obj))
-                    else:
-                        reviews.append(AugmentedUnstructured(obj, None))
-                if not reviews:
+            # per-kind containment: one kind failing (transient 5xx, an
+            # unpageable aggregated API) must not abort the whole sweep
+            # — the reference logs and moves to the next kind
+            # (manager.go:277-298's error branches)
+            try:
+                kind_results = self._review_pages(pages, ns_cache, ns_gvk)
+            except Exception as e:
+                self.log.error(
+                    "audit list/review failed for kind",
+                    err=e,
+                    gvk=str(gvk),
+                )
+                continue
+            results.extend(kind_results)
+        return results
+
+    def _review_pages(self, pages, ns_cache, ns_gvk) -> List[Any]:
+        """Review one kind's page stream; a None RESTART marker (410
+        continue-token expiry -> full relist) discards the partial
+        results so objects are never double-counted."""
+        from ..constraint import AugmentedUnstructured
+        from ..control.process import PROCESS_AUDIT
+
+        results: List[Any] = []
+        for chunk in pages:
+            if chunk is None:  # RESTART: pagination began again
+                results = []
+                continue
+            reviews = []
+            for obj in chunk:
+                ns = (obj.get("metadata") or {}).get("namespace") or ""
+                if (
+                    ns
+                    and self.excluder is not None
+                    and self.excluder.is_namespace_excluded(
+                        PROCESS_AUDIT, ns
+                    )
+                ):
                     continue
-                for responses in self.client.review_many(reviews):
-                    resp = responses.by_target.get(self.target)
-                    if resp is not None:
-                        results.extend(resp.results)
+                # attach the Namespace object (the reference's
+                # nsCache.Get, manager.go:299-317) — without it the
+                # review carries no namespace and every constraint-
+                # level namespace match degrades to cluster-scoped.
+                # A namespaced object whose Namespace is missing is
+                # SKIPPED like the reference's lookup-failure path
+                # (manager.go:307-311 logs and continues).
+                if ns:
+                    if ns not in ns_cache:
+                        ns_cache[ns] = self.cluster.get(ns_gvk, "", ns)
+                    ns_obj = ns_cache[ns]
+                    if ns_obj is None:
+                        continue
+                    reviews.append(AugmentedUnstructured(obj, ns_obj))
+                else:
+                    reviews.append(AugmentedUnstructured(obj, None))
+            if not reviews:
+                continue
+            for responses in self.client.review_many(reviews):
+                resp = responses.by_target.get(self.target)
+                if resp is not None:
+                    results.extend(resp.results)
         return results
 
     # -- sweep loop (auditManagerLoop, manager.go:344-358) -------------------

@@ -260,6 +260,12 @@ class TpuDriver(RegoDriver):
             ]:
                 del cache[key]
         self._cset.pop(target, None)
+        # a template (module) change produces new programs: the warm
+        # flag keys on this generation, so bumping it here drops the
+        # review route cold and the background re-warm loop proactively
+        # compiles the NEW policy (without it, the stale flag left
+        # re-warming to the first unlucky admission batch)
+        self._constraint_gen += 1
 
     def put_data(self, path: str, data: Any) -> None:
         super().put_data(path, data)

@@ -200,29 +200,35 @@ class KubeCluster(EventSource):
     # fetched as one giant response)
     list_chunk_size = 500
 
-    def _list_raw(self, gvk: GVK) -> Tuple[List[Dict[str, Any]], str]:
+    def _pages(self, gvk: GVK, limit: int):
+        """The limit/continue pagination protocol, shared by list() and
+        list_pages(): yields (items, list metadata) per page with
+        apiVersion/kind restamped on every item."""
         path, _ = self._gvk_path(gvk)
-        items: List[Dict[str, Any]] = []
-        rv = ""
         cont = ""
         while True:
-            qs = f"?limit={self.list_chunk_size}"
+            qs = f"?limit={limit}"
             if cont:
                 from urllib.parse import quote
 
                 qs += f"&continue={quote(cont)}"
             doc = self._request("GET", path + qs)
-            items.extend(doc.get("items") or [])
+            items = doc.get("items") or []
+            for it in items:
+                it.setdefault("apiVersion", gvk.api_version)
+                it.setdefault("kind", gvk.kind)
             meta = doc.get("metadata") or {}
-            rv = meta.get("resourceVersion", rv)
+            yield items, meta
             cont = meta.get("continue") or ""
             if not cont:
-                break
-        for it in items:
-            # list items omit apiVersion/kind; the control plane keys on
-            # them (GVK.from_obj)
-            it.setdefault("apiVersion", gvk.api_version)
-            it.setdefault("kind", gvk.kind)
+                return
+
+    def _list_raw(self, gvk: GVK) -> Tuple[List[Dict[str, Any]], str]:
+        items: List[Dict[str, Any]] = []
+        rv = ""
+        for page, meta in self._pages(gvk, self.list_chunk_size):
+            items.extend(page)
+            rv = meta.get("resourceVersion", rv)
         return items, rv
 
     def list(self, gvk: GVK) -> List[Dict[str, Any]]:
@@ -237,35 +243,34 @@ class KubeCluster(EventSource):
         """Stream the collection page by page at the given limit —
         bounded memory for huge kinds (the reference's paged audit
         listing, --audit-chunk-size + client.List w/ Continue,
-        audit/manager.go:277-298). Yields lists of items."""
+        audit/manager.go:277-298). Yields lists of items.
+
+        A continue token that expires mid-stream (410 ResourceExpired:
+        etcd compaction outruns a slow consumer) falls back to ONE full
+        relist from scratch, like client-go's pager — the caller sees
+        the fresh pages after a RESTART marker of None, so it can drop
+        partial per-kind state instead of double-counting."""
         try:
-            path, _ = self._gvk_path(gvk)
+            gen = self._pages(gvk, limit)
+            restarted = False
+            while True:
+                try:
+                    items, _meta = next(gen)
+                except StopIteration:
+                    return
+                except KubeError as e:
+                    if e.code == 410 and not restarted:
+                        restarted = True
+                        yield None  # RESTART: discard prior pages
+                        gen = self._pages(gvk, limit)
+                        continue
+                    raise
+                if items:
+                    yield items
         except KubeError as e:
             if e.code in (403, 404):
                 return  # kind not (yet) served
             raise
-        cont = ""
-        while True:
-            qs = f"?limit={limit}"
-            if cont:
-                from urllib.parse import quote
-
-                qs += f"&continue={quote(cont)}"
-            try:
-                doc = self._request("GET", path + qs)
-            except KubeError as e:
-                if e.code in (403, 404):
-                    return
-                raise
-            items = doc.get("items") or []
-            for it in items:
-                it.setdefault("apiVersion", gvk.api_version)
-                it.setdefault("kind", gvk.kind)
-            if items:
-                yield items
-            cont = (doc.get("metadata") or {}).get("continue") or ""
-            if not cont:
-                return
 
     def _collection_path(self, gvk: GVK, namespace: str = "") -> str:
         """Collection path, namespaced when the kind is and a namespace

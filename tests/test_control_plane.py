@@ -346,6 +346,69 @@ def test_ready_on_ingest_warm_swaps_in(booted):
     assert body["stats"]["audit"]["last_sweep_seconds"] is not None
 
 
+def test_background_rewarm_after_template_churn():
+    """The runner's warm loop re-compiles the fused review route after
+    template churn drops it cold — admission keeps serving on the
+    interpreter throughout and the compiled route swaps back in without
+    any request paying the compile (serve-while-compiling). Needs the
+    TpuDriver (the booted fixture's interpreter driver has no compile
+    step to warm)."""
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    cluster = FakeCluster()
+    cluster.apply(template("K8sRequiredLabels", REQ_LABELS))
+    cluster.apply(
+        constraint(
+            "K8sRequiredLabels", "need-owner", params={"labels": ["owner"]}
+        )
+    )
+    cluster.apply(config())
+    cluster.apply(pod("bad"))
+    drv = TpuDriver()
+    client = Backend(drv).new_client(K8sValidationTarget())
+    runner = Runner(cluster, client, TARGET, audit_interval=3600.0)
+    runner.start()
+    try:
+        assert runner.wait_ready(30), runner.tracker.stats()
+        # first warm may still be in flight right after boot
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not drv.review_path_warm(
+            TARGET
+        ):
+            time.sleep(0.2)
+        assert drv.review_path_warm(TARGET), "initial warmup never ran"
+        # churn: a template change bumps the constraint gen -> cold
+        new_rego = REQ_LABELS.replace("missing: %v", "rewarm: %v")
+        cluster.apply(template("K8sRequiredLabels", new_rego))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and drv.review_path_warm(TARGET):
+            time.sleep(0.05)
+        assert not drv.review_path_warm(TARGET), "churn did not go cold"
+        # admission serves correctly regardless of warm state
+        decision = runner.webhook.handler.handle(
+            {
+                "uid": "rw-1",
+                "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "operation": "CREATE",
+                "name": "rwpod",
+                "namespace": "default",
+                "userInfo": {"username": "dev"},
+                "object": pod("rwpod"),
+            }
+        )
+        assert decision.allowed is False
+        assert "rewarm:" in decision.message
+        # the background loop re-warms within a few of its 2s ticks
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not drv.review_path_warm(
+            TARGET
+        ):
+            time.sleep(0.2)
+        assert drv.review_path_warm(TARGET), "re-warm loop never recovered"
+    finally:
+        runner.stop()
+
+
 def test_template_update_churn(booted):
     cluster, runner = booted
     # tighten the template: now requires both labels via new rego message

@@ -172,7 +172,9 @@ def rand_ingress(rng, i):
     # (spec.rules[_].host): hostless rules, empty rule lists, and a
     # missing spec entirely must neither crash nor change results
     r = rng.random()
-    if r < 0.1:
+    if r < 0.05:
+        spec = None  # no spec key at all
+    elif r < 0.1:
         spec = {}
     elif r < 0.2:
         spec = {"rules": []}
@@ -188,15 +190,17 @@ def rand_ingress(rng, i):
                 for _ in range(rng.randrange(1, 3))
             ]
         }
-    return {
+    out = {
         "apiVersion": "extensions/v1beta1",
         "kind": "Ingress",
         "metadata": {
             "name": f"ing{i}",
             "namespace": rng.choice(["default", "prod"]),
         },
-        "spec": spec,
     }
+    if spec is not None:
+        out["spec"] = spec
+    return out
 
 
 def build_clients(seed):

@@ -91,6 +91,8 @@ class MockApiServer:
         self._active_watches = set()
         self.watch_410s = 0  # expired-rv rejections served
         self.fail_watch = 0  # inject: next N watch requests get ERROR-500
+        # inject: next N continue-token list requests get 410 Expired
+        self.expire_continues = 0
         self._by_path = {}
         self._groups = {}
         for gvk, plural, namespaced in REGISTRY:
@@ -318,6 +320,14 @@ class MockApiServer:
         self.list_requests += 1
         limit = int(q.get("limit", ["0"])[0] or 0)
         start = int(q.get("continue", ["0"])[0] or 0)
+        if start and self.expire_continues > 0:
+            # continue token outlived the compaction window
+            self.expire_continues -= 1
+            return h._json(
+                410,
+                {"kind": "Status", "code": 410, "reason": "Expired",
+                 "message": "The provided continue parameter is too old"},
+            )
         meta = {"resourceVersion": str(self._rv)}
         if limit and start + limit < len(items):
             meta["continue"] = str(start + limit)
@@ -775,6 +785,48 @@ def test_list_pages_streams_bounded(mock):
     assert list(
         kc.list_pages(GVK("nosuch.group", "v1", "Absent"), 3)
     ) == []
+
+
+def test_list_pages_continue_expiry_relists(mock):
+    """A continue token that expires mid-stream (410) falls back to one
+    full relist, with a None RESTART marker so consumers drop partial
+    state instead of double-counting (client-go pager behavior)."""
+    for i in range(7):
+        mock.seed(pod(f"x{i}"))
+    kc = KubeCluster(base_url=mock.url)
+    mock.expire_continues = 1
+    out = list(kc.list_pages(GVK("", "v1", "Pod"), 3))
+    assert None in out, "RESTART marker missing"
+    fresh = out[out.index(None) + 1:]
+    names = {o["metadata"]["name"] for page in fresh for o in page}
+    assert names == {f"x{i}" for i in range(7)}
+    # a second expiry inside the relist is NOT retried again
+    mock.expire_continues = 2
+    with pytest.raises(Exception):
+        list(kc.list_pages(GVK("", "v1", "Pod"), 3))
+
+
+def test_audit_review_pages_restart_discards_partial(mock):
+    """The audit consumer honors the RESTART marker: results from pages
+    seen before a 410 relist are discarded, never double-counted."""
+    from gatekeeper_tpu.audit import AuditManager
+    from gatekeeper_tpu.constraint import (
+        Backend, K8sValidationTarget, RegoDriver,
+    )
+
+    client = Backend(RegoDriver()).new_client(K8sValidationTarget())
+    client.add_template(template("K8sRequiredLabels", REQ_LABELS))
+    client.add_constraint(
+        constraint("K8sRequiredLabels", "need-owner", {"labels": ["owner"]})
+    )
+    mgr = AuditManager(client, TARGET, audit_interval=3600)
+    ns_gvk = GVK("", "v1", "Namespace")
+    page = [pod(f"r{i}") for i in range(3)]  # all violating
+    # page seen, then RESTART, then the relisted pages
+    results = mgr._review_pages(
+        iter([page, None, page]), {"default": {"metadata": {"name": "default"}}}, ns_gvk
+    )
+    assert len(results) == 3  # not 6
 
 
 def test_runner_e2e_dryrun_and_namespace_exclusion(mock):
