@@ -141,9 +141,10 @@ class AuditManager:
         self.target = target
         self.audit_from_cache = audit_from_cache
         self.cluster = cluster
-        # clamp: 0 would mean "no limit" on the wire (unbounded page)
-        # and a zero range-step in the fallback chunker
-        self.audit_chunk_size = max(1, int(audit_chunk_size))
+        # 0 keeps the upstream convention "no chunking" (manager.go:50);
+        # negatives clamp to it. Positive values bound the list page
+        # size on the wire.
+        self.audit_chunk_size = max(0, int(audit_chunk_size))
         self.excluder = excluder
         self.sink = sink if sink is not None else InMemorySink()
         self.audit_interval = audit_interval
@@ -310,7 +311,11 @@ class AuditManager:
         for gvk in sorted(self.cluster.known_gvks()):
             if gvk.group in skip_groups:
                 continue
-            if list_pages is not None:
+            if self.audit_chunk_size <= 0:
+                # chunking disabled (the upstream --audit-chunk-size=0
+                # convention): one in-memory list, one page
+                pages = iter([self.cluster.list(gvk)])
+            elif list_pages is not None:
                 # stream apiserver pages at --audit-chunk-size: bounded
                 # memory per kind (the reference's paged List w/
                 # Continue, manager.go:277-298), one fused review_many

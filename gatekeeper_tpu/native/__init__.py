@@ -25,6 +25,10 @@ from typing import Optional
 _lock = threading.Lock()
 _mod = None
 _tried = False
+# diagnosis for "toolchain present but build/load failed": tests fail
+# loudly on it instead of silently skipping while runtime degrades to
+# the 10-20x slower Python encoder
+last_build_error: Optional[str] = None
 
 
 def _build_dir() -> str:
@@ -48,9 +52,16 @@ def load_flatten_native():
         _tried = True
         if os.environ.get("GATEKEEPER_TPU_NO_NATIVE") == "1":
             return None
+        global last_build_error
         try:
             _mod = _load_or_build()
-        except Exception:
+        except subprocess.CalledProcessError as e:
+            last_build_error = (e.stderr or b"").decode(
+                "utf-8", "replace"
+            ) or str(e)
+            _mod = None
+        except Exception as e:
+            last_build_error = repr(e)
             _mod = None
         return _mod
 

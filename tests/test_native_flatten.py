@@ -10,13 +10,30 @@ from gatekeeper_tpu.flatten.encoder import (
     encode_token_table,
 )
 from gatekeeper_tpu.flatten.vocab import Vocab
+import os
+import shutil
+
+from gatekeeper_tpu import native as native_mod
 from gatekeeper_tpu.native import load_flatten_native
 
 native = load_flatten_native()
 
+# skip on MISSING TOOLCHAIN only: a toolchain that exists but fails to
+# build must FAIL these tests, not skip them — the runtime would
+# otherwise silently degrade every encode to the 10-20x slower Python
+# path while the whole parity battery silently skipped
 pytestmark = pytest.mark.skipif(
-    native is None, reason="native flattener unavailable (no toolchain)"
+    shutil.which(os.environ.get("CC", "gcc")) is None
+    or os.environ.get("GATEKEEPER_TPU_NO_NATIVE") == "1",
+    reason="no C toolchain (or native explicitly disabled)",
 )
+
+
+def test_native_build_succeeds_with_toolchain():
+    assert native is not None, (
+        "toolchain present but the native flattener failed to "
+        f"build/load:\n{native_mod.last_build_error}"
+    )
 
 WEIRD_OBJS = [
     {},
