@@ -282,13 +282,19 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
 LADDER = (5, 10, 50, 100, 200, 1000, 2000)
 
 
-def run_constraint_ladder(err=sys.stderr, rungs=LADDER):
+def run_constraint_ladder(err=sys.stderr, rungs=LADDER, budget_s=None):
     """Latency-vs-policy-count curve (VERDICT r4 #3): p50/p99/rps per
     constraint-count rung for all three serving paths — the serial
     Python-interpreter handler (the reference's architecture, measured
     serially like the Go b.N loop), the fused micro-batching handler
     (c=128), and the native C++ bridge stack (c=128). 100%-violating
-    requests, the reference harness's stress shape."""
+    requests, the reference harness's stress shape.
+
+    budget_s bounds total wall time: rungs run ENDPOINTS FIRST
+    (5, 2000, then midpoints) so a truncated run still spans the curve,
+    and a rung is skipped when the remaining budget can't cover ~1.5x
+    the previous rung's cost — an overrun must degrade the curve, not
+    erase the whole artifact (the r4 lesson applied to time)."""
     from gatekeeper_tpu.constraint import RegoDriver, TpuDriver
     from gatekeeper_tpu.webhook import ValidationHandler
     from gatekeeper_tpu.webhook.bridge import BridgeStack, build_frontend
@@ -301,8 +307,39 @@ def run_constraint_ladder(err=sys.stderr, rungs=LADDER):
     import urllib.request
 
     have_bridge = build_frontend() is not None
+    # endpoints first, then halving midpoints: [5, 2000, 100, ...]
+    remaining = sorted(rungs)
+    order: list = []
+    while remaining:
+        order.append(remaining.pop(0))
+        if remaining:
+            order.append(remaining.pop(-1))
+        if remaining:
+            mid = remaining.pop(len(remaining) // 2)
+            order.append(mid)
+    t_start = time.perf_counter()
+    last_rung_wall = 0.0
+    last_rung_n = None
     out = []
-    for n_con in rungs:
+    truncated = []
+    for n_con in order:
+        if budget_s is not None:
+            elapsed = time.perf_counter() - t_start
+            if last_rung_n is None:
+                # first rung: no cost sample yet — run it only when a
+                # cheap rung plausibly fits at all
+                fits = budget_s >= 30
+            else:
+                # cost grows roughly linearly with constraint count:
+                # scale the previous rung's wall by the count ratio
+                # (without this, the cheap 5-rung's sample green-lights
+                # the ~400x 2000-rung straight into the watchdog)
+                est = last_rung_wall * 1.5 * (n_con / last_rung_n)
+                fits = elapsed + est <= budget_s
+            if not fits:
+                truncated.append(n_con)
+                continue
+        t_rung = time.perf_counter()
         rung = {"constraints": n_con}
 
         # interpreter path, serial (subsample scaled: per-request cost
@@ -396,9 +433,20 @@ def run_constraint_ladder(err=sys.stderr, rungs=LADDER):
                 stack.stop()
         else:
             rung["bridge"] = {"skipped": "no C++ toolchain"}
+        last_rung_wall = time.perf_counter() - t_rung
+        last_rung_n = n_con
+        rung["wall_seconds"] = round(last_rung_wall, 1)
         print(f"constraint ladder rung: {rung}", file=err)
         out.append(rung)
-    return out
+    if truncated:
+        print(
+            f"constraint ladder truncated by time budget; skipped rungs "
+            f"{sorted(truncated)}",
+            file=err,
+        )
+    # rows stay homogeneous (BENCH_r* consumers index r["constraints"]);
+    # truncation is reported out-of-band
+    return sorted(out, key=lambda r: r["constraints"]), sorted(truncated)
 
 
 def run_bridge_bench(n_requests, n_constraints, err=sys.stderr):
@@ -484,7 +532,8 @@ if __name__ == "__main__":
     import json
 
     if "--ladder" in sys.argv:
-        print(json.dumps(run_constraint_ladder()))
+        rows, skipped = run_constraint_ladder()
+        print(json.dumps({"rungs": rows, "skipped": skipped}))
     else:
         n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
         n_con = int(sys.argv[2]) if len(sys.argv) > 2 else 50
