@@ -146,6 +146,7 @@ class Runner:
         self._event_wake = threading.Event()
         self._event_stop = threading.Event()
         self._warm_stop = threading.Event()
+        self._warm_thread: Optional[threading.Thread] = None
         self._event_thread = threading.Thread(
             target=self._drain_events, daemon=True
         )
@@ -338,7 +339,22 @@ class Runner:
             # flowing on the interpreter throughout; the compiled route
             # swaps in atomically when each warm completes)
             def _warm():
-                self._wait_ingested(timeout=300)
+                import time as _t
+
+                # interruptible ingestion wait: this thread is
+                # NON-daemon (a daemon killed mid-XLA-compile at
+                # interpreter exit aborts the process, 'FATAL:
+                # exception not rethrown'), so it must never out-wait
+                # a stopped runner
+                deadline = _t.monotonic() + 300
+                while (
+                    not self._warm_stop.is_set()
+                    and _t.monotonic() < deadline
+                ):
+                    if self._wait_ingested(timeout=0.5):
+                        break
+                if self._warm_stop.is_set():
+                    return
                 self.webhook.warmup()
                 drv = getattr(self.client, "_driver", None)
                 check = getattr(drv, "review_path_warm", None)
@@ -359,7 +375,10 @@ class Runner:
                             delay_seconds=delay,
                         )
 
-            threading.Thread(target=_warm, daemon=True).start()
+            self._warm_thread = threading.Thread(
+                target=_warm, name="gk-runner-warm", daemon=False
+            )
+            self._warm_thread.start()
 
         if self.readyz_port is not None:
             self._serve_readyz()
@@ -482,6 +501,9 @@ class Runner:
         return True
 
     def stop(self) -> None:
+        # signal everything first, drain components, JOIN the warm
+        # thread last — its join can ride out an in-flight XLA compile,
+        # and serving must not keep running behind that wait
         self.switch.stop()
         self._event_stop.set()
         self._warm_stop.set()
@@ -495,6 +517,9 @@ class Runner:
         if self._readyz_httpd is not None:
             self._readyz_httpd.shutdown()
         self.watch_mgr.stop()
+        if self._warm_thread is not None:
+            self._warm_thread.join(timeout=10)
+            self._warm_thread = None
 
     # -- serving helpers -----------------------------------------------------
 

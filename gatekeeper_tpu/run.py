@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--audit-interval", type=float, default=60.0)
     p.add_argument("--audit-from-cache", action="store_true")
     p.add_argument("--audit-chunk-size", type=int, default=512)
+    # --log-level (main.go:81-83; this logger's levels)
+    p.add_argument(
+        "--log-level", default="info",
+        choices=["debug", "info", "error"],
+    )
     p.add_argument("--constraint-violations-limit", type=int, default=20)
     p.add_argument("--log-denies", action="store_true")
     p.add_argument("--emit-admission-events", action="store_true")
@@ -69,7 +74,7 @@ def build_runner(args, log=None, webhook_tls: bool = True):
     from .logs import StructuredLogger
 
     if log is None:
-        log = StructuredLogger()
+        log = StructuredLogger(level=getattr(args, "log_level", "info"))
     cluster = KubeCluster(
         base_url=args.kube_url,
         token=args.kube_token,
@@ -113,7 +118,7 @@ def main(argv=None) -> int:
 
     from .logs import StructuredLogger
 
-    log = StructuredLogger()
+    log = StructuredLogger(level=args.log_level)
     cluster, runner = build_runner(args, log=log)
     log.info(
         "starting gatekeeper-tpu",
@@ -123,28 +128,37 @@ def main(argv=None) -> int:
     )
     runner.start()
 
+    # try/finally from here: the runner owns NON-daemon threads (the
+    # warm compiler), so an exception that skips runner.stop() would
+    # leave the process hanging instead of crashing-and-restarting
     metrics_httpd = None
-    if args.prometheus_port:
-        from .metrics import serve_metrics
+    try:
+        if args.prometheus_port:
+            from .metrics import serve_metrics
 
-        metrics_httpd = serve_metrics(
-            runner.metrics, port=args.prometheus_port, bind_addr="0.0.0.0"
-        )
-        log.info("metrics serving", prometheus_port=args.prometheus_port)
+            metrics_httpd = serve_metrics(
+                runner.metrics,
+                port=args.prometheus_port,
+                bind_addr="0.0.0.0",
+            )
+            log.info(
+                "metrics serving", prometheus_port=args.prometheus_port
+            )
 
-    stop = threading.Event()
+        stop = threading.Event()
 
-    def _sig(signum, frame):
-        log.info("signal received, draining", signum=signum)
-        stop.set()
+        def _sig(signum, frame):
+            log.info("signal received, draining", signum=signum)
+            stop.set()
 
-    signal.signal(signal.SIGTERM, _sig)
-    signal.signal(signal.SIGINT, _sig)
-    stop.wait()
-    if metrics_httpd is not None:
-        metrics_httpd.shutdown()
-    runner.stop()
-    cluster.stop()
+        signal.signal(signal.SIGTERM, _sig)
+        signal.signal(signal.SIGINT, _sig)
+        stop.wait()
+    finally:
+        if metrics_httpd is not None:
+            metrics_httpd.shutdown()
+        runner.stop()
+        cluster.stop()
     return 0
 
 

@@ -787,6 +787,56 @@ def test_list_pages_streams_bounded(mock):
     ) == []
 
 
+def test_process_entry_wiring(mock):
+    """The real process entry (run.build_parser + build_runner, the
+    main.go analog) boots against an apiserver, audits in paged
+    discovery mode, and serves the documented metric surface — pins the
+    flag plumbing end-to-end (a silent wiring break here is invisible
+    to unit tests; see the r4 warmup no-op)."""
+    from gatekeeper_tpu import run as runmod
+    from gatekeeper_tpu.metrics import serve_metrics
+
+    mock.seed({"apiVersion": "v1", "kind": "Namespace",
+               "metadata": {"name": "default"}})
+    mock.seed(template("K8sRequiredLabels", REQ_LABELS))
+    mock.seed(constraint("K8sRequiredLabels", "need-owner",
+                         {"labels": ["owner"]}))
+    mock.seed(config())
+    mock.seed(pod("bad"))
+    args = runmod.build_parser().parse_args(
+        [
+            "--kube-url", mock.url,
+            "--audit-interval", "3600",
+            "--audit-chunk-size", "2",
+            "--health-addr-port", "0",
+            "--log-level", "error",
+        ]
+    )
+    cluster, runner = runmod.build_runner(args, webhook_tls=False)
+    runner.start()
+    try:
+        assert runner.wait_ready(60), runner.tracker.stats()
+        assert runner.audit.audit_chunk_size == 2
+        # discovery-mode sweep (the process default) through paged lists
+        assert runner.audit.audit().total_violations == 1
+        # the exposition server main() wires serves the audit series
+        httpd = serve_metrics(runner.metrics, port=0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{httpd.server_address[1]}/metrics",
+                timeout=10,
+            ) as resp:
+                text = resp.read().decode()
+            assert 'gatekeeper_violations{enforcement_action="deny"} 1' in (
+                text
+            )
+        finally:
+            httpd.shutdown()
+    finally:
+        runner.stop()
+        cluster.stop()
+
+
 def test_list_pages_continue_expiry_relists(mock):
     """A continue token that expires mid-stream (410) falls back to one
     full relist, with a None RESTART marker so consumers drop partial
