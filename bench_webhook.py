@@ -290,11 +290,14 @@ def run_constraint_ladder(err=sys.stderr, rungs=LADDER, budget_s=None):
     (c=128), and the native C++ bridge stack (c=128). 100%-violating
     requests, the reference harness's stress shape.
 
-    budget_s bounds total wall time: rungs run ENDPOINTS FIRST
-    (5, 2000, then midpoints) so a truncated run still spans the curve,
-    and a rung is skipped when the remaining budget can't cover ~1.5x
-    the previous rung's cost — an overrun must degrade the curve, not
-    erase the whole artifact (the r4 lesson applied to time)."""
+    budget_s bounds total wall time: rungs run SMALL, MID, LARGE first
+    (a truncated run still spans the curve, and the first two samples
+    feed an affine fixed+marginal cost fit before the big rung), then
+    alternating fill. A rung is deferred when the fit's 1.5x-padded
+    estimate exceeds the remaining budget, and deferred rungs are
+    re-evaluated on later passes as samples sharpen the fit — an
+    overrun must degrade the curve, not erase the whole artifact (the
+    r4 lesson applied to time)."""
     from gatekeeper_tpu.constraint import RegoDriver, TpuDriver
     from gatekeeper_tpu.webhook import ValidationHandler
     from gatekeeper_tpu.webhook.bridge import BridgeStack, build_frontend
@@ -307,137 +310,168 @@ def run_constraint_ladder(err=sys.stderr, rungs=LADDER, budget_s=None):
     import urllib.request
 
     have_bridge = build_frontend() is not None
-    # endpoints first, then halving midpoints: [5, 2000, 100, ...]
+    # small, mid, large first (curve coverage under truncation AND two
+    # spread samples for the affine cost model before the big rung),
+    # then alternating fill
     remaining = sorted(rungs)
     order: list = []
+    if len(remaining) >= 3:
+        order.append(remaining.pop(0))
+        # true midpoint EXCLUDING the max: with 3 rungs this must pick
+        # the middle one, not the largest, or the affine fit gets no
+        # second spread sample before the big rung
+        order.append(remaining.pop((len(remaining) - 1) // 2))
+        order.append(remaining.pop(-1))
     while remaining:
         order.append(remaining.pop(0))
         if remaining:
             order.append(remaining.pop(-1))
-        if remaining:
-            mid = remaining.pop(len(remaining) // 2)
-            order.append(mid)
     t_start = time.perf_counter()
-    last_rung_wall = 0.0
-    last_rung_n = None
+    samples: list = []  # (n_constraints, wall_seconds)
+
+    def estimate(n_next: float) -> float:
+        """Predicted rung wall: affine in constraint count once two
+        spread samples exist. A pure count-ratio scale from the
+        cheapest rung books its FIXED overhead (client build, warmup,
+        replay floor) as marginal cost and over-skips the big rungs by
+        ~10x; the affine fit separates the two."""
+        lo = min(samples)
+        hi = max(samples)
+        if hi[0] > lo[0]:
+            marginal = max(0.0, (hi[1] - lo[1]) / (hi[0] - lo[0]))
+            fixed = max(0.0, lo[1] - marginal * lo[0])
+            est = fixed + marginal * n_next
+        else:
+            # one sample: its wall is mostly fixed overhead, so a raw
+            # count-ratio scale over-skips the calibration (mid) rung;
+            # cap the ratio effect — worst case we overspend one
+            # bounded rung and every later estimate has real data
+            est = hi[1] * min(n_next / hi[0], 4.0)
+        # never cheaper than a smaller rung already measured
+        return max(est, hi[1] if n_next >= hi[0] else lo[1]) * 1.5
+
     out = []
-    truncated = []
-    for n_con in order:
-        if budget_s is not None:
-            elapsed = time.perf_counter() - t_start
-            if last_rung_n is None:
-                # first rung: no cost sample yet — run it only when a
-                # cheap rung plausibly fits at all
-                fits = budget_s >= 30
-            else:
-                # cost grows roughly linearly with constraint count:
-                # scale the previous rung's wall by the count ratio
-                # (without this, the cheap 5-rung's sample green-lights
-                # the ~400x 2000-rung straight into the watchdog)
-                est = last_rung_wall * 1.5 * (n_con / last_rung_n)
-                fits = elapsed + est <= budget_s
-            if not fits:
-                truncated.append(n_con)
-                continue
-        t_rung = time.perf_counter()
-        rung = {"constraints": n_con}
+    queue = list(order)
+    progress = True
+    while queue and progress:
+        progress = False
+        deferred = []
+        for n_con in queue:
+            if budget_s is not None:
+                elapsed = time.perf_counter() - t_start
+                fits = (
+                    budget_s >= 30
+                    if not samples
+                    else elapsed + estimate(n_con) <= budget_s
+                )
+                if not fits:
+                    # re-evaluated next pass: early estimates (one
+                    # sample) are crude; later samples sharpen the
+                    # affine fit and may admit this rung after all
+                    deferred.append(n_con)
+                    continue
+            progress = True
+            t_rung = time.perf_counter()
+            rung = {"constraints": n_con}
 
-        # interpreter path, serial (subsample scaled: per-request cost
-        # grows with the rung)
-        cpu_n = max(25, min(200, 20_000 // n_con))
-        cpu_handler = ValidationHandler(
-            build_webhook_client(RegoDriver(), n_con), TARGET
-        )
-        reqs = [make_request(i) for i in range(cpu_n)]
-        cpu_handler.handle(reqs[0])  # warm
-        t0 = time.perf_counter()
-        lat = np.zeros(cpu_n)
-        for i, r in enumerate(reqs):
-            t1 = time.perf_counter()
-            cpu_handler.handle(r)
-            lat[i] = time.perf_counter() - t1
-        wall = time.perf_counter() - t0
-        rung["interp"] = {
-            "requests": cpu_n,
-            "throughput_rps": round(cpu_n / wall, 1),
-            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
-            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
-        }
-
-        # fused micro-batching path, c=128
-        client = build_webhook_client(TpuDriver(), n_con)
-        batcher = MicroBatcher(client, TARGET, window_ms=2.0)
-        handler = BatchedValidationHandler(batcher, request_timeout=60)
-        batcher.start()
-        try:
-            _warm_route(client)
-            replay(handler, [make_request(i) for i in range(512)], 128)
-            n_sub = 1500
-            r = replay(handler, [make_request(i) for i in range(n_sub)], 128)
-            rung["fused"] = {
-                k: r[k]
-                for k in ("requests", "throughput_rps", "p50_ms", "p99_ms")
-            }
-        finally:
-            batcher.stop()
-
-        # native bridge stack, c=128 full HTTP
-        if have_bridge:
-            bclient = build_webhook_client(TpuDriver(), n_con)
-            _warm_route(bclient)
-            sock = tempfile.mktemp(prefix="gk-lad-", suffix=".sock")
-            stack = BridgeStack(
-                bclient, TARGET, sock, deadline_ms=60_000,
-                request_timeout=60,
+            # interpreter path, serial (subsample scaled: per-request cost
+            # grows with the rung)
+            cpu_n = max(25, min(200, 20_000 // n_con))
+            cpu_handler = ValidationHandler(
+                build_webhook_client(RegoDriver(), n_con), TARGET
             )
-            stack.start()
+            reqs = [make_request(i) for i in range(cpu_n)]
+            cpu_handler.handle(reqs[0])  # warm
+            t0 = time.perf_counter()
+            lat = np.zeros(cpu_n)
+            for i, r in enumerate(reqs):
+                t1 = time.perf_counter()
+                cpu_handler.handle(r)
+                lat[i] = time.perf_counter() - t1
+            wall = time.perf_counter() - t0
+            rung["interp"] = {
+                "requests": cpu_n,
+                "throughput_rps": round(cpu_n / wall, 1),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+            }
+
+            # fused micro-batching path, c=128
+            client = build_webhook_client(TpuDriver(), n_con)
+            batcher = MicroBatcher(client, TARGET, window_ms=2.0)
+            handler = BatchedValidationHandler(batcher, request_timeout=60)
+            batcher.start()
             try:
-                def post(i):
-                    body = _json.dumps(
-                        {
-                            "apiVersion": "admission.k8s.io/v1",
-                            "kind": "AdmissionReview",
-                            "request": make_request(i),
-                        }
-                    ).encode()
-                    req = urllib.request.Request(
-                        f"http://127.0.0.1:{stack.port}/v1/admit",
-                        data=body,
-                        headers={"Content-Type": "application/json"},
-                        method="POST",
-                    )
-                    t1 = time.perf_counter()
-                    with urllib.request.urlopen(req, timeout=120) as resp:
-                        resp.read()
-                    return time.perf_counter() - t1
-
-                with ThreadPoolExecutor(max_workers=128) as ex:
-                    list(ex.map(post, range(512)))  # warm
+                _warm_route(client)
+                replay(handler, [make_request(i) for i in range(512)], 128)
                 n_sub = 1500
-                blat = np.zeros(n_sub)
-
-                def one(i):
-                    blat[i] = post(i)
-
-                t0 = time.perf_counter()
-                with ThreadPoolExecutor(max_workers=128) as ex:
-                    list(ex.map(one, range(n_sub)))
-                wall = time.perf_counter() - t0
-                rung["bridge"] = {
-                    "requests": n_sub,
-                    "throughput_rps": round(n_sub / wall, 1),
-                    "p50_ms": round(float(np.percentile(blat, 50)) * 1e3, 2),
-                    "p99_ms": round(float(np.percentile(blat, 99)) * 1e3, 2),
+                r = replay(handler, [make_request(i) for i in range(n_sub)], 128)
+                rung["fused"] = {
+                    k: r[k]
+                    for k in ("requests", "throughput_rps", "p50_ms", "p99_ms")
                 }
             finally:
-                stack.stop()
-        else:
-            rung["bridge"] = {"skipped": "no C++ toolchain"}
-        last_rung_wall = time.perf_counter() - t_rung
-        last_rung_n = n_con
-        rung["wall_seconds"] = round(last_rung_wall, 1)
-        print(f"constraint ladder rung: {rung}", file=err)
-        out.append(rung)
+                batcher.stop()
+
+            # native bridge stack, c=128 full HTTP
+            if have_bridge:
+                bclient = build_webhook_client(TpuDriver(), n_con)
+                _warm_route(bclient)
+                sock = tempfile.mktemp(prefix="gk-lad-", suffix=".sock")
+                stack = BridgeStack(
+                    bclient, TARGET, sock, deadline_ms=60_000,
+                    request_timeout=60,
+                )
+                stack.start()
+                try:
+                    def post(i):
+                        body = _json.dumps(
+                            {
+                                "apiVersion": "admission.k8s.io/v1",
+                                "kind": "AdmissionReview",
+                                "request": make_request(i),
+                            }
+                        ).encode()
+                        req = urllib.request.Request(
+                            f"http://127.0.0.1:{stack.port}/v1/admit",
+                            data=body,
+                            headers={"Content-Type": "application/json"},
+                            method="POST",
+                        )
+                        t1 = time.perf_counter()
+                        with urllib.request.urlopen(req, timeout=120) as resp:
+                            resp.read()
+                        return time.perf_counter() - t1
+
+                    with ThreadPoolExecutor(max_workers=128) as ex:
+                        list(ex.map(post, range(512)))  # warm
+                    n_sub = 1500
+                    blat = np.zeros(n_sub)
+
+                    def one(i):
+                        blat[i] = post(i)
+
+                    t0 = time.perf_counter()
+                    with ThreadPoolExecutor(max_workers=128) as ex:
+                        list(ex.map(one, range(n_sub)))
+                    wall = time.perf_counter() - t0
+                    rung["bridge"] = {
+                        "requests": n_sub,
+                        "throughput_rps": round(n_sub / wall, 1),
+                        "p50_ms": round(float(np.percentile(blat, 50)) * 1e3, 2),
+                        "p99_ms": round(float(np.percentile(blat, 99)) * 1e3, 2),
+                    }
+                finally:
+                    stack.stop()
+            else:
+                rung["bridge"] = {"skipped": "no C++ toolchain"}
+            wall = time.perf_counter() - t_rung
+            samples.append((n_con, wall))
+            rung["wall_seconds"] = round(wall, 1)
+            print(f"constraint ladder rung: {rung}", file=err)
+            out.append(rung)
+        queue = deferred
+    truncated = queue
     if truncated:
         print(
             f"constraint ladder truncated by time budget; skipped rungs "
