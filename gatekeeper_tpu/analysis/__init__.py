@@ -1,0 +1,30 @@
+"""Static vectorizability analysis for ConstraintTemplate Rego.
+
+Public surface:
+
+  * `analyze_template(dict)` / `analyze_modules(kind, modules)` — run
+    the analyzer; returns a `VectorizabilityReport`.
+  * `VectorizabilityReport` / `Diagnostic` — the structured outcome:
+    a verdict from the lattice `VECTORIZED | PARTIAL_ROWS |
+    INTERPRETER | INVALID` plus stable `GK-Vxxx` diagnostics.
+  * `python -m gatekeeper_tpu.analysis <paths...>` — offline template
+    linting + CI baseline enforcement (see `cli.py` / docs/analysis.md).
+
+The analyzer is consulted by `constraint/client.py` at template
+admission (INVALID templates are rejected with the diagnostics) and by
+`constraint/tpudriver.py` ahead of compilation (INTERPRETER templates
+route without a try/except around `compile_program`).
+"""
+
+from .analyzer import Analyzer, analyze_modules, analyze_template  # noqa: F401
+from .report import (  # noqa: F401
+    CODE_MISMATCH,
+    CODES,
+    Diagnostic,
+    INTERPRETER,
+    INVALID,
+    PARTIAL_ROWS,
+    VECTORIZED,
+    VectorizabilityReport,
+    verdict_meet,
+)

@@ -1,0 +1,182 @@
+"""Offline template linting CLI.
+
+    python -m gatekeeper_tpu.analysis deploy/ [more paths...]
+        [--json] [--baseline FILE] [--write-baseline FILE] [--strict]
+
+Scans the given files/directories for ConstraintTemplate YAML documents
+(directories recurse over *.yaml / *.yml; explicit *.rego file args are
+analyzed as a bare template entry module), runs the static
+vectorizability analyzer on each, and prints one report per template.
+
+Exit status:
+  0  every template analyzed, no INVALID verdicts, no baseline
+     regressions
+  1  an INVALID template, a baseline regression (a template whose
+     recorded verdict was better than the current one), or --strict
+     with any template below VECTORIZED
+  2  usage / no templates found
+
+`--baseline FILE` compares against a checked-in manifest (JSON:
+{"templates": {kind: verdict}}) so CI pins the library's vectorization
+coverage; `--write-baseline FILE` (re)generates it. New templates (not
+in the manifest) are allowed; a verdict *improvement* is reported but
+passes — refresh the baseline to lock it in.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Iterable, List, Tuple
+
+from .analyzer import analyze_modules, analyze_template
+from .report import VERDICT_ORDER, VectorizabilityReport
+
+
+def _iter_template_docs(path: str) -> Iterable[Tuple[str, Dict[str, Any]]]:
+    import yaml
+
+    with open(path) as f:
+        try:
+            docs = list(yaml.safe_load_all(f))
+        except yaml.YAMLError as e:
+            raise SystemExit(f"error: {path}: YAML parse error: {e}")
+    for doc in docs:
+        if isinstance(doc, dict) and doc.get("kind") == "ConstraintTemplate":
+            yield path, doc
+
+
+def collect_templates(
+    paths: List[str],
+) -> List[Tuple[str, Any]]:
+    """-> [(source path, template dict | rego source str)]."""
+    out: List[Tuple[str, Any]] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for fn in sorted(files):
+                    if fn.endswith((".yaml", ".yml")):
+                        out.extend(
+                            _iter_template_docs(os.path.join(root, fn))
+                        )
+        elif p.endswith((".yaml", ".yml")):
+            out.extend(_iter_template_docs(p))
+        elif p.endswith(".rego"):
+            with open(p) as f:
+                out.append((p, f.read()))
+        else:
+            raise SystemExit(f"error: unsupported path {p!r}")
+    return out
+
+
+def _analyze_one(source: str, obj: Any) -> VectorizabilityReport:
+    if isinstance(obj, str):  # bare .rego module
+        from ..constraint.errors import InvalidTemplateError
+        from ..constraint.regocompile import parse_template_module
+        from .report import INVALID
+
+        kind = os.path.splitext(os.path.basename(source))[0]
+        try:
+            module = parse_template_module(obj)
+        except InvalidTemplateError as e:
+            rep = VectorizabilityReport(kind=kind)
+            rep.add("GK-V008", str(e), severity=INVALID)
+            return rep
+        return analyze_modules(kind, [module])
+    return analyze_template(obj)
+
+
+def _worse(a: str, b: str) -> bool:
+    return VERDICT_ORDER.index(a) > VERDICT_ORDER.index(b)
+
+
+def run(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gatekeeper_tpu.analysis",
+        description="Static vectorizability linter for ConstraintTemplates",
+    )
+    ap.add_argument("paths", nargs="+", help="template YAML files or dirs")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--baseline", help="verdict manifest to compare against")
+    ap.add_argument(
+        "--write-baseline", help="write the current verdicts to FILE"
+    )
+    ap.add_argument(
+        "--strict",
+        action="store_true",
+        help="fail on any verdict below VECTORIZED",
+    )
+    args = ap.parse_args(argv)
+
+    entries = collect_templates(args.paths)
+    if not entries:
+        print("no ConstraintTemplates found", file=sys.stderr)
+        return 2
+
+    reports: List[Tuple[str, VectorizabilityReport]] = [
+        (src, _analyze_one(src, obj)) for src, obj in entries
+    ]
+
+    failures: List[str] = []
+    for _src, rep in reports:
+        if rep.verdict == "INVALID":
+            failures.append(f"{rep.kind}: INVALID")
+        elif args.strict and rep.verdict != "VECTORIZED":
+            failures.append(f"{rep.kind}: {rep.verdict} (strict)")
+
+    baseline: Dict[str, str] = {}
+    if args.baseline:
+        with open(args.baseline) as f:
+            baseline = (json.load(f) or {}).get("templates", {})
+        for _src, rep in reports:
+            want = baseline.get(rep.kind)
+            if want is not None and _worse(rep.verdict, want):
+                failures.append(
+                    f"{rep.kind}: regressed {want} -> {rep.verdict}"
+                )
+
+    if args.write_baseline:
+        manifest = {
+            "templates": {
+                rep.kind: rep.verdict for _src, rep in reports
+            }
+        }
+        with open(args.write_baseline, "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "reports": [
+                        dict(rep.to_dict(), source=src)
+                        for src, rep in reports
+                    ],
+                    "failures": failures,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for src, rep in reports:
+            print(f"[{src}] {rep.render()}")
+        if failures:
+            print("\nFAIL:", file=sys.stderr)
+            for f_ in failures:
+                print(f"  {f_}", file=sys.stderr)
+        else:
+            counts: Dict[str, int] = {}
+            for _src, rep in reports:
+                counts[rep.verdict] = counts.get(rep.verdict, 0) + 1
+            summary = ", ".join(
+                f"{v}={counts[v]}" for v in VERDICT_ORDER if v in counts
+            )
+            print(f"\nOK: {len(reports)} template(s): {summary}")
+    return 1 if failures else 0
+
+
+def main() -> None:
+    raise SystemExit(run(sys.argv[1:]))

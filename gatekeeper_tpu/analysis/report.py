@@ -1,0 +1,167 @@
+"""Vectorizability report model: verdict lattice + stable diagnostics.
+
+The analyzer (`analysis/analyzer.py`) classifies every ConstraintTemplate
+ahead of compilation into a four-point verdict lattice ordered by how
+much of the template's evaluation stays on-device:
+
+    VECTORIZED > PARTIAL_ROWS > INTERPRETER > INVALID
+
+  * VECTORIZED    — every construct is inside the symbolic compiler's
+                    exact subset: the compiled program's counts (and,
+                    where branch plans exist, renders) are exact.
+  * PARTIAL_ROWS  — compiles, but only as a *screen*: some conditions
+                    (inventory joins, builtins/comprehensions outside
+                    the exact subset) over-approximate and the flagged
+                    rows re-check on the interpreter.
+  * INTERPRETER   — the template cannot compile even as a screen (the
+                    construct aborts every retry of
+                    `engine.programs.compile_program`); the driver must
+                    route it wholesale to the interpreter.
+  * INVALID       — the template is broken in a way no engine can
+                    evaluate soundly (unsafe variables, bad entrypoint);
+                    admission should reject it with the diagnostics.
+
+Diagnostics carry stable `GK-Vxxx` codes so metrics, CI baselines, and
+operator tooling can key on them across releases (docs/analysis.md has a
+minimal Rego repro for each).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# -- verdict lattice --------------------------------------------------------
+
+VECTORIZED = "VECTORIZED"
+PARTIAL_ROWS = "PARTIAL_ROWS"
+INTERPRETER = "INTERPRETER"
+INVALID = "INVALID"
+
+# descending order: index = badness (meet = max index)
+VERDICT_ORDER: Tuple[str, ...] = (
+    VECTORIZED,
+    PARTIAL_ROWS,
+    INTERPRETER,
+    INVALID,
+)
+
+
+def verdict_meet(a: str, b: str) -> str:
+    """Lattice meet: the worse of two verdicts."""
+    return VERDICT_ORDER[
+        max(VERDICT_ORDER.index(a), VERDICT_ORDER.index(b))
+    ]
+
+
+# -- diagnostic codes -------------------------------------------------------
+
+# code -> (slug, verdict the diagnostic caps the template at)
+CODES: Dict[str, Tuple[str, str]] = {
+    "GK-V001": ("unsupported-builtin", PARTIAL_ROWS),
+    "GK-V002": ("unbounded-comprehension", PARTIAL_ROWS),
+    "GK-V003": ("cross-join-over-cap", INTERPRETER),
+    "GK-V004": ("dynamic-ref-head", INTERPRETER),
+    "GK-V005": ("unsafe-var", INVALID),
+    "GK-V006": ("inventory-dependent", PARTIAL_ROWS),
+    "GK-V007": ("unsupported-construct", INTERPRETER),
+    "GK-V008": ("invalid-entrypoint", INVALID),
+}
+
+# compiler-disagreement sentinel: the analyzer predicted compilable but
+# `CompileUnsupported` was raised anyway. Never produced by the analyzer
+# itself — the driver emits it when the consistency assertion fires.
+CODE_MISMATCH = "GK-V999"
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding, anchored to a rule/line when known."""
+
+    code: str
+    message: str
+    rule: str = ""
+    line: int = 0
+    severity: str = ""  # verdict cap; filled from CODES when empty
+
+    def cap(self) -> str:
+        if self.severity:
+            return self.severity
+        return CODES.get(self.code, ("", PARTIAL_ROWS))[1]
+
+    @property
+    def slug(self) -> str:
+        return CODES.get(self.code, ("unknown", ""))[0]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "code": self.code,
+            "slug": self.slug,
+            "message": self.message,
+            "rule": self.rule,
+            "line": self.line,
+        }
+
+    def render(self) -> str:
+        loc = f" rule={self.rule}" if self.rule else ""
+        ln = f":{self.line}" if self.line else ""
+        return f"{self.code} {self.slug}{loc}{ln}: {self.message}"
+
+
+@dataclass
+class VectorizabilityReport:
+    """Per-template analysis outcome (one report per constraint kind)."""
+
+    kind: str
+    verdict: str = VECTORIZED
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def add(
+        self,
+        code: str,
+        message: str,
+        rule: str = "",
+        line: int = 0,
+        severity: str = "",
+    ) -> None:
+        d = Diagnostic(
+            code=code, message=message, rule=rule, line=line,
+            severity=severity,
+        )
+        self.diagnostics.append(d)
+        self.verdict = verdict_meet(self.verdict, d.cap())
+
+    @property
+    def compilable(self) -> bool:
+        """May the driver attempt `compile_program` at all?"""
+        return self.verdict in (VECTORIZED, PARTIAL_ROWS)
+
+    @property
+    def codes(self) -> List[str]:
+        return sorted({d.code for d in self.diagnostics})
+
+    def primary_code(self) -> Optional[str]:
+        """The diagnostic code that set the verdict (worst cap, first
+        occurrence) — the machine-readable 'why' for routing metrics."""
+        worst: Optional[Diagnostic] = None
+        for d in self.diagnostics:
+            if worst is None or (
+                VERDICT_ORDER.index(d.cap())
+                > VERDICT_ORDER.index(worst.cap())
+            ):
+                worst = d
+        return worst.code if worst is not None else None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "verdict": self.verdict,
+            "codes": self.codes,
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
+        }
+
+    def render(self) -> str:
+        lines = [f"{self.kind}: {self.verdict}"]
+        for d in self.diagnostics:
+            lines.append(f"  {d.render()}")
+        return "\n".join(lines)

@@ -113,6 +113,18 @@ class Client:
         modules = regocompile.compile_template_modules(
             ct.kind, spec.target, spec.rego, spec.libs, self.allowed_data_fields
         )
+        # static vectorizability analysis at admission time: INVALID
+        # templates (unsafe vars, broken entrypoints) are rejected HERE
+        # with their diagnostics instead of surfacing as an evaluation
+        # surprise later; every accepted template carries its report
+        from ..analysis import analyze_modules
+
+        report = analyze_modules(ct.kind, modules)
+        if report.verdict == "INVALID":
+            raise InvalidTemplateError(
+                "template failed static analysis:\n" + report.render()
+            )
+        ct.vectorizability = report
         prefix = f'templates["{spec.target}"]["{ct.kind}"]'
         return ct, crd, spec.target, modules, prefix
 
@@ -397,6 +409,26 @@ class Client:
     def known_templates(self) -> List[str]:
         with self._lock:
             return sorted(self._templates)
+
+    def template_report(self, name_or_kind: str):
+        """Vectorizability report for an ingested template (by template
+        name or constraint kind); None when unknown."""
+        with self._lock:
+            entry = self._templates.get(name_or_kind) or self._templates.get(
+                name_or_kind.lower()
+            )
+            if entry is None:
+                return None
+            return entry.template.vectorizability
+
+    def template_reports(self) -> Dict[str, Any]:
+        """{template name -> VectorizabilityReport} for every ingested
+        template (webhook/status introspection surface)."""
+        with self._lock:
+            return {
+                name: e.template.vectorizability
+                for name, e in self._templates.items()
+            }
 
     def known_constraint_kinds(self) -> List[str]:
         with self._lock:

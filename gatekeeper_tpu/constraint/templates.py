@@ -43,6 +43,9 @@ class ConstraintTemplate:
     api_version: str = f"{TEMPLATE_GROUP}/v1beta1"
     labels: Dict[str, str] = field(default_factory=dict)
     raw: Dict[str, Any] = field(default_factory=dict)
+    # static vectorizability analysis (analysis.VectorizabilityReport),
+    # attached by the Client's compile pipeline at admission time
+    vectorizability: Optional[Any] = None
 
     @classmethod
     def from_dict(cls, obj: Dict[str, Any]) -> "ConstraintTemplate":

@@ -44,6 +44,12 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis import (
+    CODE_MISMATCH,
+    INTERPRETER as V_INTERPRETER,
+    VectorizabilityReport,
+    analyze_modules,
+)
 from ..engine.matchkernel import matchspec_to_np
 from ..engine.matchspec import compile_match_specs
 from ..engine.patterns import PatternRegistry
@@ -168,16 +174,35 @@ class _ConstraintSet:
     programs: List[Optional[Program]]  # index-aligned; None => fallback
     prog_rows: List[int]  # constraint index -> row in compiled stack (-1)
     policy: Optional[Any] = None  # StagedPolicy, device-resident
+    # kind -> analyzer diagnostic code for interpreter-routed templates
+    # (CODE_MISMATCH when the analyzer predicted compilable but the
+    # compiler disagreed)
+    fallback_codes: Dict[str, str] = None  # type: ignore[assignment]
 
 
 class TpuDriver(RegoDriver):
     """Compiled-engine driver: device-batched audit/review, interpreter
     fallback for the uncompilable remainder."""
 
-    def __init__(self, use_jax: bool = True, mesh=None):
+    def __init__(self, use_jax: bool = True, mesh=None, metrics=None):
         super().__init__()
         if use_jax:
             _enable_compile_cache()
+        # optional MetricsRegistry: per-template verdict gauges +
+        # fallback-reason counters land here when wired (Runner calls
+        # set_metrics; tests construct with metrics=)
+        self.metrics = metrics
+        # (target, kind) -> VectorizabilityReport, computed once per
+        # mounted module set (the admission-time analyzer, re-run here
+        # so the driver owns its routing decision even for modules
+        # mounted without going through Client.add_template)
+        self._analysis: Dict[Tuple[str, str], VectorizabilityReport] = {}
+        # analyzer-says-compilable but CompileUnsupported raised: the
+        # consistency assertion the old try/except routing became
+        self.analyzer_mismatches = 0
+        # (target, kind) -> diagnostic code for interpreter-routed
+        # templates (machine-readable fallback reason)
+        self._fallback_codes: Dict[Tuple[str, str], str] = {}
         self.vocab = Vocab()
         self.patterns = PatternRegistry(self.vocab)
         self.tables = StrTables(self.vocab)
@@ -254,6 +279,8 @@ class TpuDriver(RegoDriver):
     def _drop_programs(self, target: str, kind: str) -> None:
         for key in [k for k in self._programs if k[0] == target and k[1] == kind]:
             del self._programs[key]
+        self._analysis.pop((target, kind), None)
+        self._fallback_codes.pop((target, kind), None)
         for cache in (self._prune_oracles, self._prune_indexes):
             for key in [
                 k for k in cache if k[0] == target and k[1] == kind
@@ -320,6 +347,47 @@ class TpuDriver(RegoDriver):
 
         return oracle_fn
 
+    def set_metrics(self, metrics) -> None:
+        """Late metrics wiring (Runner builds its registry after the
+        driver); also re-exports verdicts already analyzed."""
+        self.metrics = metrics
+        for (_t, kind), rep in self._analysis.items():
+            self._export_verdict(kind, rep)
+
+    def template_report(
+        self, target: str, kind: str
+    ) -> Optional[VectorizabilityReport]:
+        """The analyzer's verdict for a mounted template (None when the
+        kind has no modules mounted). Computed once per module set."""
+        key = (target, kind)
+        rep = self._analysis.get(key)
+        if rep is None:
+            mods = self._kind_modules.get(key)
+            if mods is None:
+                return None
+            rep = analyze_modules(kind, mods)
+            self._analysis[key] = rep
+            self._export_verdict(kind, rep)
+        return rep
+
+    def _export_verdict(self, kind: str, rep: VectorizabilityReport):
+        if self.metrics is None:
+            return
+        self.metrics.gauge(
+            "template_vectorization", 1, kind=kind, verdict=rep.verdict
+        )
+        for code in rep.codes:
+            n = sum(1 for d in rep.diagnostics if d.code == code)
+            self.metrics.gauge(
+                "template_analysis_diagnostics", n, kind=kind, code=code
+            )
+
+    def _note_fallback(self, kind: str, code: str) -> None:
+        if self.metrics is not None:
+            self.metrics.record(
+                "template_fallback_total", 1, kind=kind, code=code
+            )
+
     def _program_for(
         self, target: str, constraint: Dict[str, Any]
     ) -> Optional[Program]:
@@ -333,6 +401,19 @@ class TpuDriver(RegoDriver):
         key = (target, kind, _params_key(params))
         if key in self._programs:
             return self._programs[key]
+        # verdict-first routing: the static analyzer decides whether
+        # compilation is even attempted. INTERPRETER/INVALID templates
+        # route immediately with their diagnostic code; for templates
+        # the analyzer calls compilable, CompileUnsupported is no
+        # longer a routing mechanism — it is a counted consistency
+        # failure (analyzer promised compilability).
+        report = self.template_report(target, kind)
+        if report is not None and not report.compilable:
+            code = report.primary_code() or "GK-V007"
+            self._fallback_codes[(target, kind)] = code
+            self._note_fallback(kind, code)
+            self._programs[key] = None
+            return None
         env = CompilerEnv(
             self.vocab,
             self.patterns,
@@ -340,10 +421,28 @@ class TpuDriver(RegoDriver):
             oracle_fn=self._make_oracle(target, kind, params),
             oracle_ns=f"{kind}|{key[2]}",
             oracle_ns_shared=f"{target}|{kind}",
+            template_kind=kind,
         )
         try:
             prog = compile_program(env, mods, params)
-        except CompileUnsupported:
+        except CompileUnsupported as e:
+            # consistency assertion: analyzer-vs-compiler disagreement
+            # is a bug signal, surfaced via counter + metric + log
+            self.analyzer_mismatches += 1
+            self._fallback_codes[(target, kind)] = CODE_MISMATCH
+            self._note_fallback(kind, CODE_MISMATCH)
+            if self.metrics is not None:
+                self.metrics.record(
+                    "analyzer_compile_mismatch_total", 1, kind=kind
+                )
+            import logging
+
+            logging.getLogger("gatekeeper_tpu.analysis").warning(
+                "analyzer/compiler disagreement: %s predicted "
+                "compilable but compilation gave up: %s",
+                kind,
+                e,
+            )
             prog = None
         self._programs[key] = prog
         return prog
@@ -378,12 +477,20 @@ class TpuDriver(RegoDriver):
             else:
                 prog_rows.append(row)
                 row += 1
+        fallback_codes = {
+            c["kind"]: self._fallback_codes.get((target, c["kind"]))
+            for c, p in zip(constraints, programs)
+            if p is None and isinstance(c.get("kind"), str)
+        }
         cs = _ConstraintSet(
             constraint_gen=self._constraint_gen,
             constraints=constraints,
             ms=matchspec_to_np(ms),
             programs=programs,
             prog_rows=prog_rows,
+            fallback_codes={
+                k: v or "GK-V007" for k, v in fallback_codes.items()
+            },
         )
         self._cset[target] = cs
         return cs
@@ -1189,6 +1296,10 @@ class TpuDriver(RegoDriver):
                 "pruned_renders": n_pruned,
                 "render_errors": self._render_errors,
                 "hot_redispatches": self._hot_redispatches,
+                # machine-readable WHY for every wholesale-interpreter
+                # template in this query's constraint set
+                "fallback_codes": dict(cs.fallback_codes or {}),
+                "analyzer_mismatches": self.analyzer_mismatches,
             }
             if trace is not None:
                 trace.append(

@@ -121,6 +121,11 @@ class TemplateController:
                 process="controller",
                 template_name=name,
             )
+        report = None
+        if ev.type != DELETED and status == "active":
+            getter = getattr(self.client, "template_report", None)
+            if getter is not None:
+                report = getter(name)
         if self.metrics is not None:
             self.metrics.record(
                 "constraint_template_ingestion_count", 1, status=status
@@ -131,8 +136,30 @@ class TemplateController:
                 status=status,
             )
             self._report_count()
+            if report is not None:
+                # per-template verdict + diagnostic-code counts: the
+                # vectorized-vs-interpreter split as a scrapeable fact
+                self.metrics.gauge(
+                    "template_vectorization",
+                    1,
+                    kind=report.kind,
+                    verdict=report.verdict,
+                )
+                for code in report.codes:
+                    self.metrics.gauge(
+                        "template_analysis_diagnostics",
+                        sum(
+                            1
+                            for d in report.diagnostics
+                            if d.code == code
+                        ),
+                        kind=report.kind,
+                        code=code,
+                    )
         if self.status is not None:
-            self.status.publish_template(name, status, self.errors.get(name))
+            self.status.publish_template(
+                name, status, self.errors.get(name), report=report
+            )
         # readiness: observed whether or not compile succeeded — an
         # erroring template must not hold the process unready forever
         # (the reference tracker observes on reconcile, not success)

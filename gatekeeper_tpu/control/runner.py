@@ -98,6 +98,12 @@ class Runner:
 
             metrics = MetricsRegistry()
         self.metrics = metrics
+        # late-wire the driver's own metrics (template verdict gauges,
+        # fallback-reason counters) into the shared registry
+        driver = getattr(client, "_driver", None)
+        set_m = getattr(driver, "set_metrics", None)
+        if set_m is not None:
+            set_m(metrics)
         self.excluder = Excluder()
         self.tracker = ReadinessTracker()
         self.switch = ControllerSwitch()

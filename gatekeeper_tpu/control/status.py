@@ -66,21 +66,34 @@ class StatusWriter:
         return f"{_dashify(self.pod_name)}-{_dashify(template)}"
 
     def publish_template(
-        self, template: str, status: str, error: Optional[str]
+        self,
+        template: str,
+        status: str,
+        error: Optional[str],
+        report: Optional[Any] = None,
     ) -> None:
+        """`report`: the template's VectorizabilityReport — the verdict
+        and diagnostic codes ride on the status CR so operators see
+        which engine a template runs on (and why) without log-diving."""
         errors: List[Dict[str, str]] = []
         if error:
             errors.append({"code": "ingest_error", "message": error})
+        payload: Dict[str, Any] = {
+            "id": self.pod_name,
+            "templateUID": template,
+            "observedGeneration": 1,
+            "errors": errors,
+        }
+        if report is not None:
+            payload["vectorization"] = {
+                "verdict": report.verdict,
+                "codes": report.codes,
+            }
         self._apply(
             TEMPLATE_STATUS_GVK,
             self._template_status_name(template),
             {POD_LABEL: self.pod_name, TEMPLATE_LABEL: template},
-            {
-                "id": self.pod_name,
-                "templateUID": template,
-                "observedGeneration": 1,
-                "errors": errors,
-            },
+            payload,
         )
 
     def delete_template(self, template: str) -> None:

@@ -81,7 +81,49 @@ ORACLE_MEMO_VERSION = 1
 
 
 class CompileUnsupported(Exception):
-    """Template uses constructs outside the compilable subset."""
+    """Template uses constructs outside the compilable subset.
+
+    Carries template-kind / rule / line provenance, filled in as the
+    exception unwinds through the clause compiler (`_compile_clause`
+    stamps rule+line, `compile_violation_counts` stamps the kind), so
+    fallback log lines and analyzer-mismatch reports cite WHERE
+    compilation gave up, not just why."""
+
+    def __init__(
+        self,
+        reason: str = "",
+        kind: str = "",
+        rule: str = "",
+        line: int = 0,
+    ):
+        self.reason = reason
+        self.kind = kind
+        self.rule = rule
+        self.line = line
+        super().__init__(reason)
+
+    def annotate(
+        self, kind: str = "", rule: str = "", line: int = 0
+    ) -> "CompileUnsupported":
+        """Fill empty provenance fields (innermost context wins)."""
+        if kind and not self.kind:
+            self.kind = kind
+        if rule and not self.rule:
+            self.rule = rule
+        if line and not self.line:
+            self.line = line
+        return self
+
+    def __str__(self) -> str:
+        ctx = []
+        if self.kind:
+            ctx.append(f"template={self.kind}")
+        if self.rule:
+            loc = self.rule + (f":{self.line}" if self.line else "")
+            ctx.append(f"rule={loc}")
+        if ctx:
+            return f"{self.reason} [{' '.join(ctx)}]"
+        return self.reason
 
 
 class InventoryDependent(Exception):
@@ -117,6 +159,8 @@ class CompilerEnv:
     # here, so constraint params variants share one fill — the fill is
     # the expensive part (one interpreter call per vocab entry)
     oracle_ns_shared: str = ""
+    # constraint kind, for CompileUnsupported provenance only
+    template_kind: str = ""
 
 
 class ConstPool:
@@ -618,6 +662,12 @@ class Compiler:
     # -- entry --------------------------------------------------------------
 
     def compile_violation_counts(self) -> Expr:
+        try:
+            return self._compile_violation_counts()
+        except CompileUnsupported as e:
+            raise e.annotate(kind=self.cenv.template_kind)
+
+    def _compile_violation_counts(self) -> Expr:
         clauses = self.rules.get("violation")
         if not clauses:
             raise CompileUnsupported("no violation rule")
@@ -626,7 +676,10 @@ class Compiler:
         for rule in clauses:
             if rule.is_default or rule.else_rule is not None:
                 raise CompileUnsupported("default/else violation rule")
-            branches.extend(self._compile_clause(rule))
+            try:
+                branches.extend(self._compile_clause(rule))
+            except CompileUnsupported as e:
+                raise e.annotate(rule=rule.head.name, line=rule.line)
         if not branches:
             return EFullN(0)
         # Rego's violation document is a SET: clauses rendering the same
@@ -1891,6 +1944,14 @@ class Compiler:
             raise
 
     def _inline_function_body(
+        self, name: str, rules: List[A.Rule], args: List[SVal], st: State
+    ):
+        try:
+            return self._inline_function_rules(name, rules, args, st)
+        except CompileUnsupported as e:
+            raise e.annotate(rule=name, line=rules[0].line)
+
+    def _inline_function_rules(
         self, name: str, rules: List[A.Rule], args: List[SVal], st: State
     ):
         self._fn_depth += 1

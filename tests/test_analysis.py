@@ -1,0 +1,327 @@
+"""Static vectorizability analyzer: verdict goldens per diagnostic
+code, admission-time wiring, and the analyzer-vs-compiler agreement
+sweep (a VECTORIZED verdict is a promise that `compile_program` does
+not raise `CompileUnsupported`; the driver counts any violation of that
+promise as `analyzer_mismatches`).
+"""
+
+import os
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.analysis import (
+    INTERPRETER,
+    INVALID,
+    PARTIAL_ROWS,
+    VECTORIZED,
+    analyze_template,
+)
+from gatekeeper_tpu.constraint import (
+    Backend,
+    InvalidTemplateError,
+    K8sValidationTarget,
+    TpuDriver,
+)
+
+def reference_available() -> bool:
+    return os.path.isdir("/root/reference")
+
+TARGET = "admission.k8s.gatekeeper.sh"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_template(kind, rego):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    }
+
+
+def analyze(kind, rego):
+    return analyze_template(make_template(kind, rego))
+
+
+# -- one golden template per diagnostic code --------------------------------
+
+CLEAN = """package k8scleandeny
+violation[{"msg": msg}] {
+    container := input.review.object.spec.containers[_]
+    startswith(container.image, input.parameters.registries[_])
+    msg := sprintf("denied registry for <%v>", [container.name])
+}
+"""
+
+G_V001 = """package k8sjsonmarshal
+violation[{"msg": msg}] {
+    raw := json.marshal(input.review.object.metadata.labels)
+    contains(raw, "forbidden")
+    msg := "label blob contains forbidden"
+}
+"""
+
+G_V002 = """package k8sobjcomp
+violation[{"msg": msg}] {
+    anns := {k: v | v := input.review.object.metadata.annotations[k]}
+    count(anns) == 0
+    msg := "no annotations"
+}
+"""
+
+G_V003 = """package k8sdeepjoin
+violation[{"msg": msg}] {
+    leaf := input.review.object.spec.l1[_].l2[_].l3[_]
+    leaf == "x"
+    msg := "three nested array iterations"
+}
+"""
+
+G_V004 = """package k8sdynref
+violation[{"msg": msg}] {
+    k := "app"
+    input.review.object.metadata.labels[upper(k)] == "x"
+    msg := "computed key segment"
+}
+"""
+
+G_V005 = """package k8sunsafe
+violation[{"msg": msg}] {
+    input.review.object.kind == "Pod"
+    msg := sprintf("%v", [never_bound])
+}
+"""
+
+G_V006 = """package k8sinvjoin
+violation[{"msg": msg}] {
+    other := data.inventory.namespace[ns][_][_][name]
+    other.spec.clusterIP == input.review.object.spec.clusterIP
+    msg := "duplicate clusterIP"
+}
+"""
+
+G_V007 = """package k8swithmod
+violation[{"msg": msg}] {
+    input.review.object.kind == "Pod" with input as {}
+    msg := "with modifier"
+}
+"""
+
+GOLDENS = [
+    # (kind, rego, verdict, expected code or None)
+    ("K8sCleanDeny", CLEAN, VECTORIZED, None),
+    ("K8sJsonMarshal", G_V001, PARTIAL_ROWS, "GK-V001"),
+    ("K8sObjComp", G_V002, PARTIAL_ROWS, "GK-V002"),
+    ("K8sDeepJoin", G_V003, INTERPRETER, "GK-V003"),
+    ("K8sDynRef", G_V004, INTERPRETER, "GK-V004"),
+    ("K8sUnsafe", G_V005, INVALID, "GK-V005"),
+    ("K8sInvJoin", G_V006, PARTIAL_ROWS, "GK-V006"),
+    ("K8sWithMod", G_V007, INTERPRETER, "GK-V007"),
+]
+
+
+@pytest.mark.parametrize(
+    "kind,rego,verdict,code", GOLDENS, ids=[g[0] for g in GOLDENS]
+)
+def test_verdict_goldens(kind, rego, verdict, code):
+    rep = analyze(kind, rego)
+    assert rep.verdict == verdict, rep.render()
+    if code is not None:
+        assert code in rep.codes, rep.render()
+    # every diagnostic cites a rule and a line (provenance contract);
+    # the entrypoint-level GK-V008 has no rule by definition
+    for d in rep.diagnostics:
+        if d.code != "GK-V008":
+            assert d.rule, rep.render()
+
+
+def test_use_before_bind_comprehension_is_not_unsafe():
+    """The uniqueserviceselector idiom — comprehension locals textually
+    consumed before their binding — must NOT be flagged GK-V005 (the
+    reorder handles it; this pins the analyzer's schedulability
+    fixpoint against the comprehension_needed over-approximation)."""
+    rep = analyze(
+        "K8sSelIdiom",
+        """package k8sselidiom
+violation[{"msg": msg}] {
+    obj := input.review.object
+    selectors := [s | s = concat(":", [key, val]); val = obj.spec.selector[key]]
+    count(selectors) == 0
+    msg := "no selectors"
+}
+""",
+    )
+    assert "GK-V005" not in rep.codes, rep.render()
+    assert rep.verdict == VECTORIZED
+
+
+def test_missing_violation_rule_is_invalid():
+    rep = analyze("K8sNoEntry", "package k8snoentry\nallow { true }\n")
+    assert rep.verdict == INVALID
+    assert "GK-V008" in rep.codes
+
+
+def test_diagnostics_render_with_provenance():
+    rep = analyze("K8sUnsafe", G_V005)
+    text = rep.render()
+    assert "GK-V005" in text and "unsafe-var" in text
+    assert "never_bound" in text
+    assert "violation" in text  # rule provenance
+
+
+# -- admission-time wiring ---------------------------------------------------
+
+
+def test_client_rejects_invalid_template():
+    cl = Backend(TpuDriver(use_jax=False)).new_client(K8sValidationTarget())
+    with pytest.raises(InvalidTemplateError) as exc:
+        cl.add_template(make_template("K8sUnsafe", G_V005))
+    assert "GK-V005" in str(exc.value)
+
+
+def test_client_attaches_report():
+    cl = Backend(TpuDriver(use_jax=False)).new_client(K8sValidationTarget())
+    cl.add_template(make_template("K8sCleanDeny", CLEAN))
+    cl.add_template(make_template("K8sWithMod", G_V007))
+    assert cl.template_report("k8scleandeny").verdict == VECTORIZED
+    assert cl.template_report("K8sWithMod").verdict == INTERPRETER
+    reports = cl.template_reports()
+    assert set(reports) == {"k8scleandeny", "k8swithmod"}
+
+
+# -- analyzer-vs-compiler agreement -----------------------------------------
+
+
+def _constraint_for(kind, params=None):
+    spec = {"match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}}
+    if params is not None:
+        spec["parameters"] = params
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": kind.lower()[:40]},
+        "spec": spec,
+    }
+
+
+GOLDEN_PARAMS = {"K8sCleanDeny": {"registries": ["docker.io/"]}}
+
+
+def test_agreement_sweep_goldens():
+    """No template the analyzer calls VECTORIZED may raise
+    CompileUnsupported, and every interpreter-routed template carries a
+    machine-readable diagnostic code."""
+    drv = TpuDriver(use_jax=False)
+    cl = Backend(drv).new_client(K8sValidationTarget())
+    want = {}
+    for kind, rego, verdict, code in GOLDENS:
+        if verdict == INVALID:
+            continue  # rejected at admission; nothing to compile
+        cl.add_template(make_template(kind, rego))
+        cl.add_constraint(_constraint_for(kind, GOLDEN_PARAMS.get(kind)))
+        want[kind] = (verdict, code)
+    cs = drv._constraint_set(TARGET)
+    assert cs is not None
+    by_kind = dict(zip((c["kind"] for c in cs.constraints), cs.programs))
+    for kind, (verdict, code) in want.items():
+        prog = by_kind[kind]
+        if verdict in (VECTORIZED, PARTIAL_ROWS):
+            assert prog is not None, (
+                f"{kind}: analyzer said {verdict} but compilation fell "
+                "back"
+            )
+        else:  # INTERPRETER
+            assert prog is None, f"{kind}: expected interpreter routing"
+            assert cs.fallback_codes.get(kind) == code
+    # the consistency assertion: zero analyzer/compiler disagreements
+    assert drv.analyzer_mismatches == 0
+
+
+def _deploy_templates():
+    with open(os.path.join(REPO, "deploy/policies/templates.yaml")) as f:
+        return [
+            d
+            for d in yaml.safe_load_all(f)
+            if isinstance(d, dict) and d.get("kind") == "ConstraintTemplate"
+        ]
+
+
+DEPLOY_PARAMS = {
+    "GTRequiredAnnotations": {"annotations": ["owner"]},
+    "GTDeniedImageRegistries": {"registries": ["docker.io/"]},
+    "GTNoLatestTag": None,
+    "GTMemoryLimitCeiling": {"maxMemory": "1Gi"},
+}
+
+
+def test_agreement_sweep_shipped_templates():
+    """The shipped deploy/ template library hits the happy path: zero
+    CompileUnsupported exceptions, zero analyzer mismatches, and every
+    template's analyzer verdict is compilable."""
+    drv = TpuDriver(use_jax=False)
+    cl = Backend(drv).new_client(K8sValidationTarget())
+    kinds = []
+    for doc in _deploy_templates():
+        rep = analyze_template(doc)
+        assert rep.compilable, rep.render()
+        cl.add_template(doc)
+        kind = doc["spec"]["crd"]["spec"]["names"]["kind"]
+        kinds.append((kind, rep.verdict))
+        cl.add_constraint(_constraint_for(kind, DEPLOY_PARAMS.get(kind)))
+    cs = drv._constraint_set(TARGET)
+    by_kind = dict(zip((c["kind"] for c in cs.constraints), cs.programs))
+    for kind, verdict in kinds:
+        if verdict == VECTORIZED:
+            assert by_kind[kind] is not None, kind
+    assert drv.analyzer_mismatches == 0
+    assert cs.fallback_codes == {}
+
+
+@pytest.mark.skipif(
+    not reference_available(), reason="reference library not present"
+)
+def test_agreement_sweep_reference_library():
+    """Every reference library template exercised by the whole-library
+    sweep keeps the VECTORIZED promise."""
+    from test_library_sweep import SWEEP, load_template
+
+    drv = TpuDriver(use_jax=False)
+    cl = Backend(drv).new_client(K8sValidationTarget())
+    verdicts = {}
+    for tdir, (kind, params, _kinds) in SWEEP.items():
+        t = load_template(tdir)
+        rep = analyze_template(t)
+        cl.add_template(t)
+        verdicts[kind] = rep.verdict
+        cl.add_constraint(_constraint_for(kind, params))
+    cs = drv._constraint_set(TARGET)
+    by_kind = dict(zip((c["kind"] for c in cs.constraints), cs.programs))
+    for kind, verdict in verdicts.items():
+        if verdict == VECTORIZED:
+            assert by_kind[kind] is not None, kind
+    assert drv.analyzer_mismatches == 0
+
+
+# -- driver stats surface ----------------------------------------------------
+
+
+def test_fallback_codes_in_query_stats():
+    drv = TpuDriver(use_jax=False)
+    cl = Backend(drv).new_client(K8sValidationTarget())
+    cl.add_template(make_template("K8sWithMod", G_V007))
+    cl.add_constraint(_constraint_for("K8sWithMod"))
+    cl.add_data(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "x"}]},
+        }
+    )
+    cl.audit()
+    assert drv.stats["fallback_codes"] == {"K8sWithMod": "GK-V007"}
+    assert drv.stats["analyzer_mismatches"] == 0

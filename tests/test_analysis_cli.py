@@ -1,0 +1,133 @@
+"""Analyzer CLI + CI baseline enforcement.
+
+The checked-in manifest (deploy/policies/analysis-baseline.json) pins
+the vectorization coverage of the shipped template library: a change
+that demotes a previously-VECTORIZED template fails the build. Runs the
+CLI in-process (cli.run) — no subprocess, no jax import.
+"""
+
+import json
+import os
+
+import pytest
+
+from gatekeeper_tpu.analysis.cli import run
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEPLOY = os.path.join(REPO, "deploy", "policies")
+BASELINE = os.path.join(DEPLOY, "analysis-baseline.json")
+
+INVALID_TEMPLATE = """apiVersion: templates.gatekeeper.sh/v1beta1
+kind: ConstraintTemplate
+metadata:
+  name: badtemplate
+spec:
+  crd:
+    spec:
+      names:
+        kind: BadTemplate
+  targets:
+    - target: admission.k8s.gatekeeper.sh
+      rego: |
+        package badtemplate
+        violation[{"msg": msg}] {
+            msg := sprintf("%v", [never_bound])
+        }
+"""
+
+
+def test_shipped_templates_hold_the_baseline(capsys):
+    """The CI gate: shipped deploy/ templates must not regress below
+    their recorded verdicts."""
+    rc = run([DEPLOY, "--baseline", BASELINE])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "OK:" in out
+
+
+def test_baseline_manifest_is_current():
+    """The checked-in manifest matches what the analyzer says today —
+    a verdict IMPROVEMENT must be locked in by regenerating it
+    (python -m gatekeeper_tpu.analysis deploy/ --write-baseline ...)."""
+    with open(BASELINE) as f:
+        recorded = json.load(f)["templates"]
+    from gatekeeper_tpu.analysis.cli import collect_templates, _analyze_one
+
+    current = {}
+    for src, obj in collect_templates([DEPLOY]):
+        rep = _analyze_one(src, obj)
+        current[rep.kind] = rep.verdict
+    assert current == recorded
+
+
+def test_regression_fails(tmp_path, capsys):
+    """A template whose recorded verdict is better than its current one
+    must fail the run."""
+    # claim a stricter past than reality by analyzing a PARTIAL template
+    # against a VECTORIZED record
+    tdir = tmp_path / "policies"
+    tdir.mkdir()
+    (tdir / "t.yaml").write_text(
+        """apiVersion: templates.gatekeeper.sh/v1beta1
+kind: ConstraintTemplate
+metadata:
+  name: invjoin
+spec:
+  crd:
+    spec:
+      names:
+        kind: InvJoin
+  targets:
+    - target: admission.k8s.gatekeeper.sh
+      rego: |
+        package invjoin
+        violation[{"msg": msg}] {
+            other := data.inventory.namespace[ns][_][_][name]
+            other.spec.x == input.review.object.spec.x
+            msg := "dup"
+        }
+"""
+    )
+    manifest = tmp_path / "baseline.json"
+    manifest.write_text(json.dumps({"templates": {"InvJoin": "VECTORIZED"}}))
+    rc = run([str(tdir), "--baseline", str(manifest)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "regressed VECTORIZED -> PARTIAL_ROWS" in err
+
+
+def test_invalid_template_fails(tmp_path, capsys):
+    (tmp_path / "bad.yaml").write_text(INVALID_TEMPLATE)
+    rc = run([str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "GK-V005" in captured.out
+    assert "INVALID" in captured.err
+
+
+def test_write_baseline_round_trips(tmp_path):
+    out = tmp_path / "manifest.json"
+    rc = run([DEPLOY, "--write-baseline", str(out)])
+    assert rc == 0
+    with open(out) as f, open(BASELINE) as g:
+        assert json.load(f) == json.load(g)
+
+
+def test_json_output(tmp_path, capsys):
+    rc = run([DEPLOY, "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    kinds = {r["kind"]: r["verdict"] for r in payload["reports"]}
+    assert kinds.get("GTNoLatestTag") == "VECTORIZED"
+    assert payload["failures"] == []
+
+
+def test_no_templates_found(tmp_path):
+    assert run([str(tmp_path)]) == 2
+
+
+def test_unsupported_path_rejected(tmp_path):
+    p = tmp_path / "notes.txt"
+    p.write_text("hi")
+    with pytest.raises(SystemExit):
+        run([str(p)])

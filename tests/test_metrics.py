@@ -73,6 +73,121 @@ def test_prometheus_label_escaping():
         assert line.count('"') % 2 == 0
 
 
+def _tpu_client(reg):
+    from gatekeeper_tpu.constraint import (
+        Backend,
+        K8sValidationTarget,
+        TpuDriver,
+    )
+
+    drv = TpuDriver(use_jax=False, metrics=reg)
+    return drv, Backend(drv).new_client(K8sValidationTarget())
+
+
+def _template(kind, rego):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [
+                {"target": "admission.k8s.gatekeeper.sh", "rego": rego}
+            ],
+        },
+    }
+
+
+def _constraint(kind):
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}
+        },
+    }
+
+
+def test_driver_template_verdict_and_fallback_metrics():
+    """The TPU driver's analyzer wiring exposes per-template verdicts
+    and interpreter-fallback reasons keyed by GK-Vxxx diagnostic code."""
+    reg = MetricsRegistry()
+    drv, cl = _tpu_client(reg)
+    cl.add_template(
+        _template(
+            "K8sVecMetric",
+            'package k8svecmetric\nviolation[{"msg": msg}] {\n'
+            '  c := input.review.object.spec.containers[_]\n'
+            '  endswith(c.image, ":latest")\n'
+            '  msg := "latest tag"\n}\n',
+        )
+    )
+    cl.add_template(
+        _template(
+            "K8sInterpMetric",
+            'package k8sinterpmetric\nviolation[{"msg": msg}] {\n'
+            '  input.review.object.kind == "Pod" with input as {}\n'
+            '  msg := "with modifier"\n}\n',
+        )
+    )
+    cl.add_constraint(_constraint("K8sVecMetric"))
+    cl.add_constraint(_constraint("K8sInterpMetric"))
+    drv._constraint_set("admission.k8s.gatekeeper.sh")
+    snap = reg.snapshot()
+    g = snap["gauges"]
+    assert (
+        g['template_vectorization{kind="K8sVecMetric",verdict="VECTORIZED"}']
+        == 1
+    )
+    assert (
+        g[
+            'template_vectorization{kind="K8sInterpMetric",'
+            'verdict="INTERPRETER"}'
+        ]
+        == 1
+    )
+    assert (
+        g[
+            'template_analysis_diagnostics{code="GK-V007",'
+            'kind="K8sInterpMetric"}'
+        ]
+        >= 1
+    )
+    c = snap["counters"]
+    assert (
+        c['template_fallback_total{code="GK-V007",kind="K8sInterpMetric"}']
+        == 1
+    )
+    # the vectorized template routed compiled: no fallback, no mismatch
+    assert not any("K8sVecMetric" in k for k in c)
+    assert not any("analyzer_compile_mismatch_total" in k for k in c)
+    assert drv.analyzer_mismatches == 0
+
+
+def test_driver_set_metrics_reexports_verdicts():
+    """Late wiring (Runner builds the registry after the driver) still
+    surfaces verdicts that were analyzed before the registry arrived."""
+    drv, cl = _tpu_client(None)
+    cl.add_template(
+        _template(
+            "K8sLateWire",
+            'package k8slatewire\nviolation[{"msg": msg}] {\n'
+            '  input.review.object.kind == "Pod"\n'
+            '  msg := "pod seen"\n}\n',
+        )
+    )
+    cl.add_constraint(_constraint("K8sLateWire"))
+    drv._constraint_set("admission.k8s.gatekeeper.sh")
+    reg = MetricsRegistry()
+    drv.set_metrics(reg)
+    g = reg.snapshot()["gauges"]
+    assert (
+        g['template_vectorization{kind="K8sLateWire",verdict="VECTORIZED"}']
+        == 1
+    )
+
+
 def test_serve_metrics_http():
     reg = MetricsRegistry()
     reg.record("requests", 9)

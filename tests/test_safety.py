@@ -1,0 +1,210 @@
+"""Direct unit coverage for rego/safety.py — the OPA-style body reorder.
+
+The reorder was exercised only indirectly (through the interpreter and
+compiler batteries) until the static analyzer made it load-bearing for
+the bound-before-use check; these tests pin its edge cases standalone:
+wildcards, nested comprehensions, negation grounding, mutually-
+dependent literals, and stability for already-safe bodies.
+"""
+
+from gatekeeper_tpu.rego import ast as A
+from gatekeeper_tpu.rego import safety
+from gatekeeper_tpu.rego.parser import parse_module
+
+KNOWN = {"input", "data"}
+
+
+def rule_body(src: str):
+    mod = parse_module("package t\n" + src)
+    assert len(mod.rules) == 1
+    return mod.rules[0].body
+
+
+def bound_order(body):
+    """Var-binding order after the reorder (line numbers of exprs)."""
+    ordered = safety.reorder_body(body, set(), KNOWN)
+    return [e.line for e in ordered]
+
+
+def test_already_safe_body_is_stable():
+    body = rule_body(
+        "r {\n"
+        "  x := input.a\n"
+        "  y := x\n"
+        "  y == 1\n"
+        "}\n"
+    )
+    assert safety.reorder_body(body, set(), KNOWN) == body
+
+
+def test_use_before_bind_reorders():
+    # `y` is consumed textually before the expression that binds it —
+    # the uniqueserviceselector comprehension idiom
+    body = rule_body(
+        "r {\n"
+        "  x := concat(\":\", [y, y])\n"
+        "  y := input.a\n"
+        "}\n"
+    )
+    ordered = safety.reorder_body(body, set(), KNOWN)
+    assert isinstance(ordered[0], A.Assign)
+    assert ordered[0].target.name == "y"
+    assert ordered[1].target.name == "x"
+
+
+def test_wildcards_never_bind_or_block():
+    body = rule_body(
+        "r {\n"
+        "  input.spec.containers[_].name == x\n"
+        "  x := input.name\n"
+        "}\n"
+    )
+    ordered = safety.reorder_body(body, set(), KNOWN)
+    # the x-binding must schedule first; the wildcard contributes no
+    # variable in either direction
+    assert isinstance(ordered[0], A.Assign)
+    assert safety.all_vars(ordered[0], KNOWN) == {"x"}
+
+
+def test_unify_schedulable_from_either_side():
+    # `a = b`: schedulable when EITHER side is fully bound
+    body = rule_body(
+        "r {\n"
+        "  a = input.x\n"
+        "  a = b\n"
+        "  b == 1\n"
+        "}\n"
+    )
+    bound = set()
+    for e in safety.reorder_body(body, set(), KNOWN):
+        assert safety.can_schedule(e, bound, KNOWN)
+        bound |= safety.all_vars(e, KNOWN)
+    assert {"a", "b"} <= bound
+
+
+def test_negation_requires_ground_vars():
+    # `not p(x)` cannot schedule until x is bound: the binding must
+    # reorder ahead of the negation even though it appears after
+    body = rule_body(
+        "r {\n"
+        "  not f(x)\n"
+        "  x := input.a\n"
+        "}\n"
+    )
+    ordered = safety.reorder_body(body, set(), KNOWN)
+    assert isinstance(ordered[0], A.Assign)
+    assert isinstance(ordered[1], A.NotExpr)
+
+
+def test_mutually_dependent_literals_stay_in_order():
+    # x = y; y = x: genuinely unsafe — no reorder helps; the body must
+    # come back in ORIGINAL order (the evaluator reports the unsafe
+    # var) rather than loop or drop expressions
+    body = rule_body(
+        "r {\n"
+        "  x = y\n"
+        "  y = x\n"
+        "}\n"
+    )
+    ordered = safety.reorder_body(body, set(), KNOWN)
+    assert ordered == body
+    assert not safety.can_schedule(ordered[0], set(), KNOWN)
+
+
+def test_comprehension_outer_needs_block_scheduling():
+    # the comprehension references `sel` which only the second literal
+    # binds: comprehension_needed must surface `sel` as an outer need
+    body = rule_body(
+        "r {\n"
+        "  xs := [s | s := concat(\":\", [sel, sel])]\n"
+        "  sel := input.spec.selector\n"
+        "}\n"
+    )
+    comp = body[0].value
+    assert isinstance(comp, A.Comprehension)
+    # with nothing known, `sel` is an outer need — and the local `s` is
+    # blocked ON it, so the fixpoint reports both (documented
+    # over-approximation; callers fold bound vars into `known`)
+    assert safety.comprehension_needed(comp, KNOWN) == {"s", "sel"}
+    # once `sel` counts as known/bound, the body schedules and the
+    # comprehension needs nothing from outside
+    assert safety.comprehension_needed(comp, KNOWN | {"sel"}) == set()
+    ordered = safety.reorder_body(body, set(), KNOWN)
+    assert ordered[0].target.name == "sel"
+    assert ordered[1].target.name == "xs"
+
+
+def test_comprehension_locals_stay_local():
+    # vars bound INSIDE a comprehension body must not leak as outer
+    # needs nor count as outer bindings
+    body = rule_body(
+        "r {\n"
+        "  xs := {c | c := input.spec.containers[_]}\n"
+        "  count(xs) > 0\n"
+        "}\n"
+    )
+    comp = body[0].value
+    assert safety.comprehension_needed(comp, KNOWN) == set()
+    assert safety.all_vars(body[0], KNOWN) == {"xs"}
+
+
+def test_nested_comprehension_needs_propagate():
+    # inner comprehension needs `k`, which neither comprehension binds:
+    # the need must propagate through both nesting levels
+    body = rule_body(
+        "r {\n"
+        "  xs := [ys | ys := [z | z := concat(\"-\", [k, k])]]\n"
+        "  k := input.key\n"
+        "}\n"
+    )
+    comp = body[0].value
+    # `k` propagates out through both nesting levels (with the blocked
+    # locals riding along, as above); with `k` known the needs vanish
+    assert "k" in safety.comprehension_needed(comp, KNOWN)
+    assert safety.comprehension_needed(comp, KNOWN | {"k"}) == set()
+    ordered = safety.reorder_body(body, set(), KNOWN)
+    assert ordered[0].target.name == "k"
+
+
+def test_somedecl_binds_names():
+    body = rule_body(
+        "r {\n"
+        "  some i\n"
+        "  input.spec.containers[i].name == \"c\"\n"
+        "}\n"
+    )
+    assert safety.all_vars(body[0], KNOWN) == {"i"}
+    assert safety.expr_needed(body[0], KNOWN) == set()
+
+
+def test_ref_bracket_operands_bind_not_need():
+    # bracket operands may be bound by enumeration: they are pattern
+    # position, not value position
+    body = rule_body(
+        "r {\n"
+        "  input.spec.containers[i].image == x\n"
+        "}\n"
+    )
+    assert safety.expr_needed(body[0], KNOWN) == {"x"}
+    assert safety.all_vars(body[0], KNOWN) == {"i", "x"}
+
+
+def test_object_pattern_keys_are_value_position():
+    # object KEYS in pattern position still need their vars bound
+    # (needed_pattern: keys evaluate, values may bind)
+    obj = A.ObjectTerm(items=[(A.Var("k"), A.Var("v"))])
+    assert safety.needed_pattern(obj, KNOWN) == {"k"}
+    assert safety.needed_value(obj, KNOWN) == {"k", "v"}
+
+
+def test_bound0_seeds_the_schedule():
+    # function formals arrive pre-bound
+    body = rule_body(
+        "f(a) {\n"
+        "  b := concat(\"/\", [a, a])\n"
+        "  b == \"x/x\"\n"
+        "}\n"
+    )
+    ordered = safety.reorder_body(body, {"a"}, KNOWN)
+    assert ordered == body
+    assert safety.can_schedule(ordered[0], {"a"}, KNOWN)
