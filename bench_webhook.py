@@ -189,9 +189,17 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
             file=err,
         )
 
+    from gatekeeper_tpu.obs import Tracer, span_breakdown
+
     client = build_webhook_client(TpuDriver(), n_constraints)
-    batcher = MicroBatcher(client, TARGET, window_ms=2.0)
-    handler = BatchedValidationHandler(batcher, request_timeout=60)
+    # every replayed request is traced; the per-span-name percentile
+    # table (span_breakdown) attributes the p99 to its cost center —
+    # queue wait vs flatten/encode vs device dispatch vs render
+    tracer = Tracer(max_traces=8192)
+    batcher = MicroBatcher(client, TARGET, window_ms=2.0, tracer=tracer)
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=60, tracer=tracer
+    )
     batcher.start()
     try:
         # flip the serve-while-compiling route to warm SYNCHRONOUSLY
@@ -209,6 +217,7 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
             [make_request(i, violating=False) for i in range(512)],
             128,
         )
+        tracer.clear()  # warmup traces must not pollute the breakdown
 
         out = []
         # two violation profiles:
@@ -242,6 +251,8 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
                 )
                 out.append(r)
                 print(f"webhook replay: {r}", file=err)
+        breakdown = span_breakdown(tracer.recent(8192))
+        print(f"webhook span breakdown (ms): {breakdown}", file=err)
     finally:
         batcher.stop()
     bridge = run_bridge_bench(n_requests, n_constraints, err=err)
@@ -267,6 +278,9 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
         "interp_rps_by_concurrency": interp_by_conc,
         "fused_vs_interp_crossover_concurrency": crossover,
         "tpu_batched": out,
+        # per-span-name p50/p99/max over every measured request: the
+        # diagnosable form of the p99 cliff (which cost center blew up)
+        "span_breakdown_ms": breakdown,
         "tpu_bridge": bridge,
     }
     print(

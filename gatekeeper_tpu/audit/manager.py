@@ -124,6 +124,7 @@ class AuditManager:
         audit_chunk_size: int = 512,
         excluder=None,
         logger=None,
+        tracer=None,
         # boot barrier: the loop's FIRST sweep waits for this (the
         # runner passes wait_ready) so warmup runs on the fully
         # ingested state, not an empty cache — the warm sweep is what
@@ -133,6 +134,9 @@ class AuditManager:
         from ..logs import null_logger
 
         self.log = logger if logger is not None else null_logger()
+        # obs.Tracer: each sweep is one trace (audit_sweep root with
+        # per-phase children — dispatch/list, aggregate, status_write)
+        self.tracer = tracer
         self.wait_for = wait_for
         # set after the first completed sweep: the audit path is warm
         # (kernels compiled, corpus encoded+staged, render caches primed)
@@ -169,7 +173,12 @@ class AuditManager:
         """One full sweep, then the reference's aggregation contract
         (cap, truncate, publish). From-cache mode sweeps the synced
         state in one fused Client.audit; direct mode lists the cluster
-        GVK-by-GVK in chunks through the batched review path."""
+        GVK-by-GVK in chunks through the batched review path. Each
+        sweep is one trace: audit_sweep -> dispatch (or per-kind
+        list_and_review spans in direct mode) / aggregate /
+        status_write, mirrored into `audit_phase_seconds`."""
+        from ..obs import start_span
+
         t0 = self._now()
         timestamp = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime(int(t0))
@@ -177,14 +186,39 @@ class AuditManager:
         # every record of this sweep shares the audit id
         # (manager.go:148: am.log = log.WithValues(logging.AuditID, ts))
         log = self.log.with_values(process="audit", audit_id=timestamp)
-        if self.audit_from_cache or self.cluster is None:
-            log.info("Auditing from cache")
-            resp = self.client.audit().by_target.get(self.target)
-            results = resp.results if resp is not None else []
-        else:
-            log.info("Auditing via discovery client")
-            results = self._audit_resources()
+        with start_span(
+            self.tracer, "audit_sweep", audit_id=timestamp,
+            from_cache=bool(self.audit_from_cache or self.cluster is None),
+        ) as root:
+            return self._audit_once(t0, timestamp, log, root)
 
+    def _audit_once(self, t0, timestamp, log, root) -> AuditReport:
+        from ..obs import start_span
+
+        t_disp0 = time.time()
+        with start_span(self.tracer, "dispatch", parent=root) as dsp:
+            if self.audit_from_cache or self.cluster is None:
+                log.info("Auditing from cache")
+                resp = self.client.audit().by_target.get(self.target)
+                results = resp.results if resp is not None else []
+            else:
+                log.info("Auditing via discovery client")
+                results = self._audit_resources()
+            stats = getattr(
+                getattr(self.client, "_driver", None), "stats", None
+            )
+            if isinstance(stats, dict):
+                dsp.set_attr(
+                    **{
+                        k: stats[k]
+                        for k in (
+                            "compiled_pairs", "interp_pairs",
+                            "hot_redispatches", "n_reviews",
+                        )
+                        if k in stats
+                    }
+                )
+        t_agg0 = time.time()
         statuses: Dict[str, ConstraintStatus] = {}
         totals_by_ea: Dict[str, int] = {}
         for r in results:
@@ -270,10 +304,32 @@ class AuditManager:
                 constraint_status="enforced",
                 constraint_violations=str(st.total_violations),
             )
+        t_pub0 = time.time()
         self.sink.publish(report)
+        t_pub1 = time.time()
+        if self.tracer is not None:
+            # aggregate/status_write stamped from timing marks instead
+            # of open spans: an exception mid-aggregation must not leave
+            # a dangling open span pinning the sweep trace
+            self.tracer.record_span(
+                "aggregate", t_agg0, t_pub0, parent=root,
+                violations=len(results),
+            )
+            self.tracer.record_span(
+                "status_write", t_pub0, t_pub1, parent=root,
+                statuses=len(statuses),
+            )
         self.last_run_seconds = t0
         self.audit_duration_seconds = duration
         if self.metrics is not None:
+            for phase, dt in (
+                ("dispatch", t_agg0 - t_disp0),
+                ("aggregate", t_pub0 - t_agg0),
+                ("status_write", t_pub1 - t_pub0),
+            ):
+                self.metrics.observe(
+                    "audit_phase_seconds", dt, phase=phase
+                )
             # the audit stats reporter's metric surface
             # (pkg/audit/stats_reporter.go; docs/Metrics.md:83-104);
             # enforcement actions seen in PRIOR sweeps re-report 0 when
@@ -331,6 +387,7 @@ class AuditManager:
             # unpageable aggregated API) must not abort the whole sweep
             # — the reference logs and moves to the next kind
             # (manager.go:277-298's error branches)
+            t_kind = time.time()
             try:
                 kind_results = self._review_pages(pages, ns_cache, ns_gvk)
             except Exception as e:
@@ -339,7 +396,21 @@ class AuditManager:
                     err=e,
                     gvk=str(gvk),
                 )
+                if self.tracer is not None:
+                    self.tracer.record_span(
+                        "list_and_review", t_kind, time.time(),
+                        parent=self.tracer.current(), status="error",
+                        gvk=str(gvk), error=str(e),
+                    )
                 continue
+            if self.tracer is not None:
+                # one span per kind under the sweep's dispatch span
+                # (direct mode's list/chunk/review phase)
+                self.tracer.record_span(
+                    "list_and_review", t_kind, time.time(),
+                    parent=self.tracer.current(),
+                    gvk=str(gvk), results=len(kind_results),
+                )
             results.extend(kind_results)
         return results
 

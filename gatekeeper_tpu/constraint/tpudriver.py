@@ -216,6 +216,7 @@ class TpuDriver(RegoDriver):
             self.kernel = FusedAuditKernel(
                 self.patterns, self.tables, mesh=mesh
             )
+            self.kernel.metrics = metrics  # compile/cache telemetry
         else:
             self.kernel = None
         # (target, kind) -> rewritten template modules
@@ -351,6 +352,8 @@ class TpuDriver(RegoDriver):
         """Late metrics wiring (Runner builds its registry after the
         driver); also re-exports verdicts already analyzed."""
         self.metrics = metrics
+        if self.kernel is not None:
+            self.kernel.metrics = metrics
         for (_t, kind), rep in self._analysis.items():
             self._export_verdict(kind, rep)
 
@@ -387,6 +390,13 @@ class TpuDriver(RegoDriver):
             self.metrics.record(
                 "template_fallback_total", 1, kind=kind, code=code
             )
+
+    def _count(self, name: str, value: float = 1, **tags) -> None:
+        """Counter increment alongside the in-object stat counters —
+        the Prometheus view of cold_batches/_hot_redispatches/
+        _render_errors, incremented at the same sites."""
+        if self.metrics is not None:
+            self.metrics.record(name, value, **tags)
 
     def _program_for(
         self, target: str, constraint: Dict[str, Any]
@@ -657,6 +667,18 @@ class TpuDriver(RegoDriver):
             return corpus.staged
         n = len(corpus.reviews)
         chunk = min(N_CHUNK, _bucket(n, lo=64))
+        if self.metrics is not None:
+            # device-batch shape telemetry: bucketed chunk shapes trade
+            # padded rows for jit-shape stability — occupancy % and
+            # waste rows quantify what that trade costs per staging
+            padded = chunk * -(-n // chunk)
+            path = "audit" if corpus.data_gen >= 0 else "webhook"
+            self.metrics.observe(
+                "batch_occupancy_percent", 100.0 * n / padded, path=path
+            )
+            self.metrics.record(
+                "padding_waste_rows_total", padded - n, path=path
+            )
         chunks = []
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
@@ -875,6 +897,7 @@ class TpuDriver(RegoDriver):
         from ..parallel.sharding import StagedBatch
 
         self._hot_redispatches += 1
+        self._count("driver_hot_redispatch_total")
         r_cap = 1 << (n_hot - 1).bit_length()
         batch = StagedBatch(
             fb_dev={k: v[ci] for k, v in stacked.fb_dev.items()},
@@ -971,6 +994,7 @@ class TpuDriver(RegoDriver):
             # interpreter and compile in the background; once warm the
             # route swaps to the fused path
             self.cold_batches += 1
+            self._count("driver_cold_batches_total")
             self._kick_warm(target, inputs)
         if cold or len(inputs) < MIN_DEVICE_BATCH:
             # adaptive routing: a tiny batch finishes faster on the
@@ -1149,6 +1173,7 @@ class TpuDriver(RegoDriver):
                 # background (holding every admission on an inline XLA
                 # compile would blow the webhook deadline)
                 self.cold_batches += 1
+                self._count("driver_cold_batches_total")
                 self._kick_warm(target, inputs)
                 split = [
                     RegoDriver._violation(self, target, i or {}, None)
@@ -1197,6 +1222,9 @@ class TpuDriver(RegoDriver):
         require_compiled propagates to the kernel dispatch: ColdKernel
         escapes (before any result is produced) when this batch's shape
         bucket has no compiled entry yet."""
+        import time as _time
+
+        t_start = _time.perf_counter()
         with self._mutex:
             cs = self._constraint_set(target)
             if cs is None:
@@ -1210,6 +1238,7 @@ class TpuDriver(RegoDriver):
                 )
             self.patterns.sync()
             self.tables.sync()
+            t_encoded = _time.perf_counter()
             c_count = len(cs.constraints)
             n_count = len(reviews)
             if self.use_jax:
@@ -1220,6 +1249,7 @@ class TpuDriver(RegoDriver):
                 pairs, stat_c, stat_i = self._need_pairs_np(
                     cs, corpus, ns_cache, n_count
                 )
+            t_dispatched = _time.perf_counter()
             # only the sparse pair set needing interpreter work is
             # visited in Python — violating compiled pairs (count > 0)
             # plus every matched fallback pair, review-major (matching
@@ -1285,6 +1315,17 @@ class TpuDriver(RegoDriver):
                         render_cache[(n_i, c_i)] = out
                 per_review[n_i].extend(out)
                 n_results += len(out)
+            t_done = _time.perf_counter()
+            # the per-query cost-center split: how long this evaluation
+            # spent flattening/encoding reviews into tensors, executing
+            # the fused device dispatch (incl. any inline jit compile),
+            # and rendering violation messages. The micro-batch bridge
+            # and audit manager turn these into trace spans.
+            phase_seconds = {
+                "flatten_encode": t_encoded - t_start,
+                "device_dispatch": t_dispatched - t_encoded,
+                "render": t_done - t_dispatched,
+            }
             self.stats = {
                 "compiled_pairs": stat_c,
                 "interp_pairs": stat_i,
@@ -1296,11 +1337,26 @@ class TpuDriver(RegoDriver):
                 "pruned_renders": n_pruned,
                 "render_errors": self._render_errors,
                 "hot_redispatches": self._hot_redispatches,
+                "phase_seconds": phase_seconds,
                 # machine-readable WHY for every wholesale-interpreter
                 # template in this query's constraint set
                 "fallback_codes": dict(cs.fallback_codes or {}),
                 "analyzer_mismatches": self.analyzer_mismatches,
             }
+            if self.metrics is not None:
+                path = "audit" if corpus.data_gen >= 0 else "webhook"
+                m = self.metrics
+                m.record("driver_pairs_total", stat_c, route="compiled",
+                         path=path)
+                m.record("driver_pairs_total", stat_i, route="interp",
+                         path=path)
+                m.record("driver_render_total", n_host, route="host")
+                m.record("driver_render_total",
+                         n_interp_render - n_pruned, route="interp")
+                m.record("driver_render_total", n_pruned, route="pruned")
+                for phase, dt in phase_seconds.items():
+                    m.observe("driver_phase_seconds", dt, phase=phase,
+                              path=path)
             if trace is not None:
                 trace.append(
                     f"tpu dispatch: {self.stats['compiled_pairs']} compiled "
@@ -1537,6 +1593,7 @@ class TpuDriver(RegoDriver):
                 # a plan evaluation bug must degrade to the interpreter,
                 # never fail the sweep; surfaced via stats for tests
                 self._render_errors += 1
+                self._count("driver_render_errors_total")
                 continue
             for n_i, c_i in plist:
                 objs = row_objs.get(n_i)

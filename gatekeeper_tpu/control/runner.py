@@ -84,8 +84,14 @@ class Runner:
         cert_dir: Optional[str] = None,
         # serving bind address: loopback for tests, "0.0.0.0" in-cluster
         bind_addr: str = "127.0.0.1",
+        # obs.Tracer threaded through webhook + audit; None builds one
+        # (tracing is always on — the ring is bounded)
+        tracer=None,
     ):
         from ..logs import null_logger
+        from ..obs import Tracer
+
+        self.tracer = tracer if tracer is not None else Tracer()
 
         self.cluster = cluster
         self.client = client
@@ -306,6 +312,7 @@ class Runner:
                 emit_admission_events=self.emit_admission_events,
                 log_denies=self.log_denies,
                 logger=self.log.with_values(process="webhook"),
+                tracer=self.tracer,
                 cert_dir=self.cert_dir,
                 bind_addr=self.bind_addr,
             )
@@ -333,6 +340,7 @@ class Runner:
                 cluster=self.cluster,
                 excluder=self.excluder,
                 logger=self.log,
+                tracer=self.tracer,
                 wait_for=self._wait_ingested,
             )
             self.audit.start()
@@ -587,10 +595,41 @@ class Runner:
                             ),
                             "errors": runner.audit.error_count,
                         }
+                    drv = getattr(runner.client, "_driver", None)
+                    if drv is not None and hasattr(drv, "stats"):
+                        # engine routing health (docs/metrics.md): WHY
+                        # templates run interpreted + the analyzer/
+                        # compiler consistency assertion
+                        d_stats = drv.stats or {}
+                        stats["driver"] = {
+                            "fallback_codes": d_stats.get(
+                                "fallback_codes",
+                                {
+                                    k[1]: v
+                                    for k, v in getattr(
+                                        drv, "_fallback_codes", {}
+                                    ).items()
+                                },
+                            ),
+                            "analyzer_mismatches": getattr(
+                                drv, "analyzer_mismatches", 0
+                            ),
+                            "cold_batches": getattr(
+                                drv, "cold_batches", 0
+                            ),
+                        }
                     payload = json.dumps(
                         {"ready": ok, "stats": stats}
                     ).encode()
                     self.send_response(200 if ok else 503)
+                elif self.path.split("?")[0] == "/debug/traces":
+                    # recent request/sweep traces (docs/observability.md)
+                    from ..metrics.registry import _traces_n
+
+                    payload = runner.tracer.export_json(
+                        n=_traces_n(self.path)
+                    ).encode()
+                    self.send_response(200)
                 elif self.path == "/healthz":
                     payload = b'{"ok": true}'
                     self.send_response(200)

@@ -34,6 +34,10 @@ RESOURCE_KIND = "resource_kind"
 RESOURCE_API_VERSION = "resource_api_version"
 RESOURCE_NAMESPACE = "resource_namespace"
 RESOURCE_NAME = "resource_name"
+# engine-specific: correlates a log record with its request trace in
+# /debug/traces (docs/observability.md); bound via with_values by the
+# webhook handler so every denial names the trace that explains it
+TRACE_ID = "trace_id"
 
 _LEVELS = {"debug": 10, "info": 20, "error": 40, "off": 99}
 

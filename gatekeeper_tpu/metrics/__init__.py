@@ -8,6 +8,16 @@ request_count, request_duration_seconds, violations,
 audit_duration_seconds, audit_last_run_time, constraints,
 constraint_templates, sync, watch_manager_* — tagged with the same
 label keys (admission_status, enforcement_action, status, ...).
+
+Latency distributions (`*_seconds`) expose as REAL Prometheus
+histograms — cumulative `_bucket{le=...}` series plus `_min`/`_max`
+gauge companions — so p50/p99 are recoverable from /metrics
+(docs/metrics.md). The full emitted-name set is contract-tested
+against docs/metrics.md by tests/test_metrics_contract.py.
 """
 
-from .registry import MetricsRegistry, serve_metrics  # noqa: F401
+from .registry import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    serve_metrics,
+)

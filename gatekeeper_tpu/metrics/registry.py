@@ -1,13 +1,24 @@
-"""In-process metrics: counters, gauges, distributions; Prometheus text
-format exposition over stdlib HTTP."""
+"""In-process metrics: counters, gauges, distributions with optional
+histogram buckets; Prometheus text format exposition over stdlib HTTP."""
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Default latency buckets for *_seconds distributions (14 finite bounds
+# + +Inf at exposition). Spans 100µs..30s: the fused admission path p50
+# sits in the low milliseconds while a cold XLA compile is tens of
+# seconds — the p99 cliff BENCH_r05 surfaced needs resolution at BOTH
+# ends or the histogram quantiles saturate exactly where they matter.
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
 
 
 def _tag_key(tags: Dict[str, Any]) -> Tuple:
@@ -20,23 +31,59 @@ class _Dist:
     total: float = 0.0
     minimum: float = float("inf")
     maximum: float = float("-inf")
+    # histogram bounds (ascending) and per-bound NON-cumulative counts;
+    # cumulation happens at exposition. None = plain summary.
+    bounds: Optional[Tuple[float, ...]] = None
+    bucket_counts: Optional[List[int]] = None
 
     def add(self, v: float) -> None:
         self.count += 1
         self.total += v
         self.minimum = min(self.minimum, v)
         self.maximum = max(self.maximum, v)
+        if self.bounds is not None:
+            # index of the first bound >= v (le semantics); v above the
+            # last bound lands in the trailing +Inf slot
+            self.bucket_counts[bisect.bisect_left(self.bounds, v)] += 1
 
 
 class MetricsRegistry:
     """Record-style API mirroring pkg/metrics/record.go: one call site
-    per measurement, tags as keyword args."""
+    per measurement, tags as keyword args.
+
+    Distributions whose name ends in `_seconds` get
+    DEFAULT_LATENCY_BUCKETS automatically and expose as Prometheus
+    histograms (`_bucket`/`_sum`/`_count` plus `_min`/`_max` gauges);
+    override per metric with `set_buckets` (before the first sample) or
+    pass `buckets=()` to keep a bucketless summary."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple], float] = {}
         self._gauges: Dict[Tuple[str, Tuple], float] = {}
         self._dists: Dict[Tuple[str, Tuple], _Dist] = {}
+        self._bucket_conf: Dict[str, Tuple[float, ...]] = {}
+        self._help: Dict[str, str] = {}
+
+    # -- configuration -------------------------------------------------------
+
+    def set_buckets(self, name: str, bounds: Sequence[float]) -> None:
+        """Histogram bounds for `name` (ascending; +Inf is implicit).
+        An empty sequence forces plain summary exposition. Applies to
+        samples recorded AFTER the call — configure at wiring time."""
+        self._bucket_conf[name] = tuple(sorted(set(float(b) for b in bounds)))
+
+    def describe(self, name: str, text: str) -> None:
+        """# HELP text for `name` (one line; defaults to the name)."""
+        self._help[name] = " ".join(str(text).split())
+
+    def _bounds_for(self, name: str) -> Optional[Tuple[float, ...]]:
+        conf = self._bucket_conf.get(name)
+        if conf is not None:
+            return conf or None
+        if name.endswith("_seconds"):
+            return DEFAULT_LATENCY_BUCKETS
+        return None
 
     # -- write ---------------------------------------------------------------
 
@@ -55,10 +102,24 @@ class MetricsRegistry:
         """Add a sample to a distribution (latency histograms)."""
         key = (name, _tag_key(tags))
         with self._lock:
-            self._dists.setdefault(key, _Dist()).add(value)
+            d = self._dists.get(key)
+            if d is None:
+                bounds = self._bounds_for(name)
+                d = self._dists[key] = _Dist(
+                    bounds=bounds,
+                    bucket_counts=(
+                        [0] * (len(bounds) + 1)
+                        if bounds is not None
+                        else None
+                    ),
+                )
+            d.add(value)
 
     def timed(self, name: str, **tags):
-        """Context manager: records elapsed seconds into `name`."""
+        """Context manager: records elapsed seconds into `name`, tagged
+        `status=ok|error` by whether the block raised (unless the
+        caller already supplied a status tag) — error latency must be
+        separable from success latency or timeouts hide inside p99."""
         reg = self
 
         class _Timer:
@@ -66,8 +127,12 @@ class MetricsRegistry:
                 self.t0 = time.perf_counter()
                 return self
 
-            def __exit__(self, *exc):
-                reg.observe(name, time.perf_counter() - self.t0, **tags)
+            def __exit__(self, exc_type, *exc):
+                out = tags
+                if "status" not in tags:
+                    out = dict(tags)
+                    out["status"] = "error" if exc_type else "ok"
+                reg.observe(name, time.perf_counter() - self.t0, **out)
                 return False
 
         return _Timer()
@@ -88,6 +153,19 @@ class MetricsRegistry:
                         "min": d.minimum if d.count else None,
                         "max": d.maximum if d.count else None,
                         "avg": d.total / d.count if d.count else None,
+                        **(
+                            {
+                                "buckets": [
+                                    [b, c]
+                                    for b, c in zip(
+                                        list(d.bounds) + ["+Inf"],
+                                        _cumulate(d.bucket_counts),
+                                    )
+                                ]
+                            }
+                            if d.bounds is not None
+                            else {}
+                        ),
                     }
                     for k, d in self._dists.items()
                 },
@@ -109,55 +187,121 @@ class MetricsRegistry:
         inner = ",".join(f'{k}="{cls._escape(v)}"' for k, v in tags)
         return f"{name}{{{inner}}}"
 
+    @staticmethod
+    def _suffixed(base: str, suffix: str, extra_label: str = "") -> str:
+        """Attach a series suffix to the metric NAME (before the label
+        braces), optionally injecting one extra label (le for
+        buckets)."""
+        if "{" in base:
+            stem, rest = base.split("{", 1)
+            if extra_label:
+                rest = f"{extra_label},{rest}"
+            return f"{stem}{suffix}{{{rest}"
+        if extra_label:
+            return f"{base}{suffix}{{{extra_label}}}"
+        return f"{base}{suffix}"
+
     def prometheus_text(self, prefix: str = "gatekeeper_") -> str:
         """Prometheus exposition format (prometheus_exporter.go's output
-        namespace is "gatekeeper")."""
+        namespace is "gatekeeper"). Every family gets `# HELP` and
+        `# TYPE`; bucketed distributions expose as histograms,
+        bucketless ones as summaries, and both carry `_min`/`_max`
+        gauge companions (docs/metrics.md's distribution contract)."""
         lines = []
         typed = set()
 
-        def _type(name: str, kind: str) -> None:
+        def _head(name: str, kind: str) -> None:
             if name not in typed:
                 typed.add(name)
+                help_text = self._help.get(
+                    name, name.replace("_", " ")
+                )
+                lines.append(f"# HELP {prefix}{name} {help_text}")
                 lines.append(f"# TYPE {prefix}{name} {kind}")
+
+        def _fnum(v: float) -> str:
+            return repr(v) if isinstance(v, float) else str(v)
 
         with self._lock:
             for (name, tags), v in sorted(self._counters.items()):
-                _type(name, "counter")
+                _head(name, "counter")
                 lines.append(f"{prefix}{self._fmt((name, tags))} {v}")
             for (name, tags), v in sorted(self._gauges.items()):
-                _type(name, "gauge")
+                _head(name, "gauge")
                 lines.append(f"{prefix}{self._fmt((name, tags))} {v}")
             for (name, tags), d in sorted(self._dists.items()):
-                _type(name, "summary")
+                kind = "histogram" if d.bounds is not None else "summary"
+                _head(name, kind)
                 base = self._fmt((name, tags))
-                if tags:
-                    stem, rest = base.split("{", 1)
-                    count_s = f"{stem}_count{{{rest}"
-                    sum_s = f"{stem}_sum{{{rest}"
-                else:
-                    count_s, sum_s = f"{base}_count", f"{base}_sum"
-                lines.append(f"{prefix}{count_s} {d.count}")
-                lines.append(f"{prefix}{sum_s} {d.total}")
+                if d.bounds is not None:
+                    cum = _cumulate(d.bucket_counts)
+                    for bound, c in zip(d.bounds, cum):
+                        series = self._suffixed(
+                            base, "_bucket",
+                            f'le="{_fnum(float(bound))}"',
+                        )
+                        lines.append(f"{prefix}{series} {c}")
+                    inf = self._suffixed(base, "_bucket", 'le="+Inf"')
+                    lines.append(f"{prefix}{inf} {d.count}")
+                lines.append(
+                    f"{prefix}{self._suffixed(base, '_count')} {d.count}"
+                )
+                lines.append(
+                    f"{prefix}{self._suffixed(base, '_sum')} {d.total}"
+                )
+                if d.count:
+                    # min/max companions (no native Prometheus slot in
+                    # either histogram or summary): typed as gauges
+                    for suffix, val in (
+                        ("_min", d.minimum), ("_max", d.maximum)
+                    ):
+                        _head(f"{name}{suffix}", "gauge")
+                        lines.append(
+                            f"{prefix}{self._suffixed(base, suffix)} {val}"
+                        )
         return "\n".join(lines) + "\n"
 
 
+def _cumulate(counts: List[int]) -> List[int]:
+    out, acc = [], 0
+    for c in counts:
+        acc += c
+        out.append(acc)
+    return out
+
+
 def serve_metrics(
-    registry: MetricsRegistry, port: int = 0, bind_addr: str = "127.0.0.1"
+    registry: MetricsRegistry,
+    port: int = 0,
+    bind_addr: str = "127.0.0.1",
+    tracer=None,
 ) -> ThreadingHTTPServer:
     """Serve /metrics (Prometheus text) on a background thread; returns
     the server (server_address[1] carries the bound port). The reference
     serves the same on --prometheus-port 8888; in-cluster runs bind
-    0.0.0.0 so Prometheus can scrape the pod IP (run.py wires this)."""
+    0.0.0.0 so Prometheus can scrape the pod IP (run.py wires this).
+    With a tracer, /debug/traces serves the recent-trace ring as JSON
+    (?n= bounds the count) on the same plane."""
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802
-            if self.path != "/metrics":
+            if self.path == "/metrics":
+                payload = registry.prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif (
+                tracer is not None
+                and self.path.split("?")[0] == "/debug/traces"
+            ):
+                payload = tracer.export_json(
+                    n=_traces_n(self.path)
+                ).encode()
+                ctype = "application/json"
+            else:
                 self.send_response(404)
                 self.end_headers()
                 return
-            payload = registry.prometheus_text().encode()
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
@@ -169,3 +313,14 @@ def serve_metrics(
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     return httpd
+
+
+def _traces_n(path: str) -> int:
+    """?n= from a /debug/traces request path (default 50, clamped)."""
+    from urllib.parse import parse_qs, urlparse
+
+    try:
+        n = int(parse_qs(urlparse(path).query).get("n", ["50"])[0])
+    except (ValueError, TypeError):
+        n = 50
+    return max(1, min(n, 1000))

@@ -1,0 +1,21 @@
+"""Observability: in-process tracing (spans, ring retention, JSON
+export) threaded through the admission and audit paths. See
+docs/observability.md for the span taxonomy and wiring map."""
+
+from .tracer import (
+    NOOP_SPAN,
+    Span,
+    SpanContext,
+    Tracer,
+    span_breakdown,
+    start_span,
+)
+
+__all__ = [
+    "NOOP_SPAN",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "span_breakdown",
+    "start_span",
+]

@@ -26,6 +26,7 @@ padded resource rows are sliced off after gather.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -196,6 +197,11 @@ class FusedAuditKernel:
         self.patterns = patterns
         self.tables = tables
         self.mesh = mesh
+        # optional MetricsRegistry: per-dispatch program-cache hit/miss
+        # counters + compile-time distributions (TpuDriver.set_metrics
+        # wires this; kernel telemetry is how a p99 cliff gets blamed
+        # on XLA compiles vs device execution)
+        self.metrics = None
         # key -> [closure, jitted|None]: one entry per distinct
         # (group-set, shapes, n, g) specialization
         self._jit_cache: Dict[Tuple, List[Any]] = {}
@@ -216,6 +222,22 @@ class FusedAuditKernel:
     # Small on purpose: the tunnel h2d path moves ~5-8MB/s, and a
     # webhook batch interns only a few hundred new vocab entries
     _DELTA_ROWS = 512
+
+    def _note_cache(self, op: str, result: str) -> None:
+        """program_cache_total{op, result=hit|miss|cold}: every jit
+        specialization lookup. `cold` = require_compiled found no entry
+        (the serve-while-compiling bounce to the interpreter)."""
+        if self.metrics is not None:
+            self.metrics.record(
+                "program_cache_total", 1, op=op, result=result
+            )
+
+    def _note_compile(self, op: str, seconds: float) -> None:
+        """First-call wall time of a fresh jit entry — trace + XLA
+        compile (jax.jit compiles synchronously inside the first call;
+        result arrays come back async, so execution is excluded)."""
+        if self.metrics is not None:
+            self.metrics.observe("program_compile_seconds", seconds, op=op)
 
     def _spec(self, *axes) -> Optional[NamedSharding]:
         if self.mesh is None:
@@ -629,7 +651,10 @@ class FusedAuditKernel:
         )
         entry = self._jit_cache.get(key)
         if entry is None and require_compiled:
+            self._note_cache("need_all", "cold")
             raise ColdKernel(f"no compiled entry for {key[:3]}")
+        was_miss = entry is None
+        self._note_cache("need_all", "miss" if was_miss else "hit")
         if entry is None:
             need_chunk = self._need_chunk_fn(policy, g, r_cap)
 
@@ -680,6 +705,7 @@ class FusedAuditKernel:
             entry = [run_all, jax.jit(run_all)]
             self._jit_cache[key] = entry
         tabs = self._tables_device()
+        t_call = time.perf_counter()
         out = entry[1](
             policy.ms_dev,
             policy.spec_map,
@@ -694,6 +720,8 @@ class FusedAuditKernel:
             corpus.ov_dev or {},
             jnp.int32(corpus.v_base),
         )
+        if was_miss:
+            self._note_compile("need_all", time.perf_counter() - t_call)
         buf = np.asarray(out)  # ONE transfer for the whole sweep
         # unpack (see run_all): [pwords | hot | n_hot | sc | si]
         r_eff = min(r_cap, corpus.chunk)
@@ -882,12 +910,16 @@ class FusedAuditKernel:
                tuple(sorted(row_in)), tuple(sorted(ov_in)))
         entry = self._jit_cache.get(key)
         if entry is None and require_compiled:
+            self._note_cache("need", "cold")
             raise ColdKernel(f"no compiled entry for {key[:3]}")
+        was_miss = entry is None
+        self._note_cache("need", "miss" if was_miss else "hit")
         if entry is None:
             run_need = self._need_chunk_fn(policy, g, r_cap)
             entry = [run_need, jax.jit(run_need)]
             self._jit_cache[key] = entry
         tabs = self._tables_device()
+        t_call = time.perf_counter()
         out = entry[1](
             policy.ms_dev,
             policy.spec_map,
@@ -902,6 +934,8 @@ class FusedAuditKernel:
             ov_in,
             jnp.int32(v_base),
         )
+        if was_miss:
+            self._note_compile("need", time.perf_counter() - t_call)
         if not block:
             return out
         packed, hot, n_hot, stat_c, stat_i = _get_overlapped(out)

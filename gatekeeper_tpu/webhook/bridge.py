@@ -186,12 +186,19 @@ class BridgeStack:
         deadline_ms: int = 2000,
         window_ms: float = 2.0,
         exempt_namespaces=(),
+        metrics=None,
+        tracer=None,
         **handler_kwargs,
     ):
         from .namespacelabel import NamespaceLabelHandler
         from .server import BatchedValidationHandler, MicroBatcher
 
-        self.batcher = MicroBatcher(client, target, window_ms=window_ms)
+        self.batcher = MicroBatcher(
+            client, target, window_ms=window_ms,
+            metrics=metrics, tracer=tracer,
+        )
+        handler_kwargs.setdefault("metrics", metrics)
+        handler_kwargs.setdefault("tracer", tracer)
         self.handler = BatchedValidationHandler(
             self.batcher, **handler_kwargs
         )

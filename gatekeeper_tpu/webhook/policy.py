@@ -106,10 +106,15 @@ class ValidationHandler:
         emit_admission_events: bool = False,
         trace_log: Optional[Callable[[str], None]] = None,
         logger=None,
+        tracer=None,
     ):
         from ..logs import null_logger
 
         self.client = client
+        # optional obs.Tracer: every handled request becomes a trace
+        # (span taxonomy in docs/observability.md); denial log records
+        # carry the trace_id for correlation
+        self.tracer = tracer
         self.target = target
         self.excluder = excluder
         self.namespace_getter = namespace_getter
@@ -130,8 +135,27 @@ class ValidationHandler:
     def handle(self, request: Dict[str, Any]) -> AdmissionResponse:
         import time as _time
 
+        from ..obs import start_span
+
         t0 = _time.perf_counter()
-        resp = self._handle(request)
+        kind = request.get("kind") or {}
+        with start_span(
+            self.tracer,
+            "handler",
+            resource_kind=kind.get("kind", ""),
+            resource_namespace=request.get("namespace", ""),
+            resource_name=request.get("name", ""),
+            operation=request.get("operation", ""),
+            username=(request.get("userInfo") or {}).get("username", ""),
+        ) as span:
+            resp = self._handle(request, span)
+            span.set_attr(
+                admission_status=(
+                    "allow" if resp.allowed
+                    else ("error" if resp.code >= 500 else "deny")
+                ),
+                code=resp.code,
+            )
         if self.metrics is not None:
             status = (
                 "allow" if resp.allowed
@@ -148,7 +172,11 @@ class ValidationHandler:
             )
         return resp
 
-    def _handle(self, request: Dict[str, Any]) -> AdmissionResponse:
+    def _handle(self, request: Dict[str, Any], span=None) -> AdmissionResponse:
+        from ..obs import NOOP_SPAN
+
+        if span is None:
+            span = NOOP_SPAN
         user = (request.get("userInfo") or {}).get("username", "")
         if user == SERVICE_ACCOUNT:
             return AdmissionResponse(True, "Gatekeeper does not self-manage")
@@ -184,13 +212,13 @@ class ValidationHandler:
         if self.trace_config is not None:
             trace_enabled, dump = self.trace_config.level(request)
         try:
-            results = self._review(request, tracing=trace_enabled)
+            results = self._review(request, tracing=trace_enabled, span=span)
         except Exception as e:
             return AdmissionResponse(False, str(e), code=500)
         if dump:
             self._emit_trace(self.client.dump())
 
-        msgs = self._deny_messages(results, request)
+        msgs = self._deny_messages(results, request, trace_id=span.trace_id)
         if msgs:
             return AdmissionResponse(False, "\n".join(msgs), code=403)
         return AdmissionResponse(True, "")
@@ -203,10 +231,13 @@ class ValidationHandler:
             self.trace_log(text)
 
     def _review(
-        self, request: Dict[str, Any], tracing: bool = False
+        self, request: Dict[str, Any], tracing: bool = False, span=None
     ) -> List[Any]:
+        from ..obs import start_span
+
         review = self._augment(request)
-        responses = self.client.review(review, tracing=tracing)
+        with start_span(self.tracer, "dispatch", parent=span, route="serial"):
+            responses = self.client.review(review, tracing=tracing)
         resp = responses.by_target.get(self.target)
         if tracing and resp is not None and resp.trace:
             self._emit_trace(resp.trace)
@@ -220,11 +251,20 @@ class ValidationHandler:
         return AugmentedReview(request, namespace=ns_obj)
 
     def _deny_messages(
-        self, results: List[Any], request: Dict[str, Any]
+        self,
+        results: List[Any],
+        request: Dict[str, Any],
+        trace_id: Optional[str] = None,
     ) -> List[str]:
         """getDenyMessages (:224-282): deny messages are
         '[denied by <constraint>] <msg>'; dryrun results are recorded
-        but never deny."""
+        but never deny. Every denial record carries the request's
+        trace_id so /debug/traces explains the latency behind it."""
+        log = (
+            self.log.with_values(trace_id=trace_id)
+            if trace_id is not None
+            else self.log
+        )
         msgs: List[str] = []
         for r in results:
             cname = ((r.constraint or {}).get("metadata") or {}).get(
@@ -233,7 +273,7 @@ class ValidationHandler:
             if r.enforcement_action in ("deny", "dryrun") and self.log_denies:
                 # --log-denies (policy.go:240-252): one structured
                 # record per violation with the reference's key set
-                self.log.info(
+                log.info(
                     "denied admission",
                     process="admission",
                     event_type="violation",
@@ -253,6 +293,7 @@ class ValidationHandler:
                     {
                         "process": "admission",
                         "event_type": "violation",
+                        "trace_id": trace_id,
                         "constraint_name": cname,
                         "constraint_action": r.enforcement_action,
                         "resource_namespace": request.get("namespace", ""),

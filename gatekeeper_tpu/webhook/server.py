@@ -75,13 +75,21 @@ class MicroBatcher:
         window_ms: float = 2.0,
         max_batch: int = 256,
         namespace_getter: Optional[Callable[[str], Optional[dict]]] = None,
+        metrics=None,
+        tracer=None,
     ):
         self.client = client
         self.target = target
         self.window = window_ms / 1000.0
         self.max_batch = max_batch
         self.namespace_getter = namespace_getter
-        self._pending: List[Tuple[Dict[str, Any], Future]] = []
+        self.metrics = metrics
+        # obs.Tracer: the batch worker stamps queue-wait + dispatch +
+        # render spans into EVERY member request's trace (the shared
+        # batch window, recorded per trace so each is self-contained)
+        self.tracer = tracer
+        # (request, future, span ctx | None, (wall, perf) submit stamp)
+        self._pending: List[Tuple[Dict[str, Any], Future, Any, Tuple]] = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -111,17 +119,18 @@ class MicroBatcher:
         if leftover:
             self._dispatch(leftover)
 
-    def submit(self, request: Dict[str, Any]) -> Future:
+    def submit(self, request: Dict[str, Any], span_ctx=None) -> Future:
         fut: Future = Future()
+        stamp = (time.time(), time.perf_counter())
         with self._lock:
             stopped = self._stop
             if not stopped:
-                self._pending.append((request, fut))
+                self._pending.append((request, fut, span_ctx, stamp))
                 n = len(self._pending)
         if stopped:
             # worker is gone (and stop() may have already drained its
             # leftovers): dispatch inline so the caller never hangs
-            self._dispatch([(request, fut)])
+            self._dispatch([(request, fut, span_ctx, stamp)])
         elif n == 1 or n >= self.max_batch:
             self._wake.set()
         return fut
@@ -153,9 +162,10 @@ class MicroBatcher:
             if self._stop:
                 return
 
-    def _dispatch(self, batch: List[Tuple[Dict[str, Any], Future]]) -> None:
+    def _dispatch(self, batch: List[Tuple[Dict[str, Any], Future, Any, Tuple]]) -> None:
+        wall0, t0 = time.time(), time.perf_counter()
         reviews = []
-        for request, _ in batch:
+        for request, _, _, _ in batch:
             ns_obj = None
             namespace = request.get("namespace", "")
             if namespace and self.namespace_getter is not None:
@@ -169,7 +179,9 @@ class MicroBatcher:
             # cannot fail the whole batch — requests still get correct
             # answers and only their own failure surfaces to them
             self.batch_failures += 1
-            for review, (_, fut) in zip(reviews, batch):
+            if self.metrics is not None:
+                self.metrics.record("webhook_batch_failures_total", 1)
+            for review, (_, fut, _, _) in zip(reviews, batch):
                 try:
                     responses = self.client.review(review)
                     resp = responses.by_target.get(self.target)
@@ -178,12 +190,61 @@ class MicroBatcher:
                     )
                 except Exception as e:
                     fut.set_exception(e)
+            self._record_spans(batch, wall0, t0, route="fallback")
             return
         self.batches_dispatched += 1
         self.requests_batched += len(batch)
-        for (_, fut), responses in zip(batch, all_responses):
+        if self.metrics is not None:
+            self.metrics.record("webhook_batches_total", 1)
+            self.metrics.observe("webhook_batch_size", len(batch))
+        self._record_spans(batch, wall0, t0, route="batched")
+        for (_, fut, _, _), responses in zip(batch, all_responses):
             resp = responses.by_target.get(self.target)
             fut.set_result(resp.results if resp is not None else [])
+
+    def _record_spans(self, batch, wall0: float, t0: float, route: str) -> None:
+        """Stamp this batch's shared timing window into every traced
+        member request: queue_wait (submit -> dispatch start), dispatch
+        (the fused evaluation), its flatten_encode / device_execute
+        children from the driver's per-query phase split, and render.
+        Phase offsets are synthesized sequentially inside the dispatch
+        window — the driver reports durations, not wall stamps."""
+        if self.tracer is None:
+            return
+        wall1 = wall0 + (time.perf_counter() - t0)
+        drv = getattr(self.client, "_driver", None)
+        stats = getattr(drv, "stats", None)
+        phases: Dict[str, float] = {}
+        attrs: Dict[str, Any] = {}
+        if isinstance(stats, dict):
+            phases = stats.get("phase_seconds") or {}
+            for k in ("compiled_pairs", "interp_pairs", "n_results"):
+                if k in stats:
+                    attrs[k] = stats[k]
+        render_s = phases.get("render", 0.0)
+        for _, _, ctx, (sub_wall, _sub_perf) in batch:
+            if ctx is None:
+                continue
+            self.tracer.record_span(
+                "queue_wait", sub_wall, wall0, parent=ctx
+            )
+            d_ctx = self.tracer.record_span(
+                "dispatch", wall0, wall1, parent=ctx,
+                batch_size=len(batch), route=route, **attrs
+            )
+            cursor = wall0
+            for phase in ("flatten_encode", "device_dispatch"):
+                dt = phases.get(phase)
+                if dt:
+                    self.tracer.record_span(
+                        phase, cursor, cursor + dt, parent=d_ctx
+                    )
+                    cursor += dt
+            # always recorded: on the interpreter route rendering is
+            # inlined in the evaluation, reported as a point span
+            self.tracer.record_span(
+                "render", wall1 - render_s, wall1, parent=d_ctx
+            )
 
 
 class BatchedValidationHandler(ValidationHandler):
@@ -205,13 +266,14 @@ class BatchedValidationHandler(ValidationHandler):
         self.request_timeout = request_timeout
 
     def _review(
-        self, request: Dict[str, Any], tracing: bool = False
+        self, request: Dict[str, Any], tracing: bool = False, span=None
     ) -> List[Any]:
         if tracing:
             # traced requests bypass the batcher: traces are per-request
             # by definition (the driver's batched path declines tracing)
-            return super()._review(request, tracing=True)
-        return self.batcher.submit(request).result(
+            return super()._review(request, tracing=True, span=span)
+        ctx = getattr(span, "context", None)
+        return self.batcher.submit(request, span_ctx=ctx).result(
             timeout=self.request_timeout
         )
 
@@ -240,15 +302,18 @@ class WebhookServer:
         emit_admission_events: bool = False,
         log_denies: bool = False,
         logger=None,
+        tracer=None,
         # "127.0.0.1" keeps tests hermetic; in-cluster serving must bind
         # the pod IP surface ("0.0.0.0" via run.py) or the apiserver and
         # kubelet probes can never connect
         bind_addr: str = "127.0.0.1",
     ):
         self.client = client  # warmup() compiles through it
+        self.tracer = tracer
         self.batcher = MicroBatcher(
             client, target, window_ms=window_ms,
             namespace_getter=namespace_getter,
+            metrics=metrics, tracer=tracer,
         )
         self.handler = BatchedValidationHandler(
             self.batcher, excluder=excluder, metrics=metrics,
@@ -258,6 +323,7 @@ class WebhookServer:
             emit_admission_events=emit_admission_events,
             log_denies=log_denies,
             logger=logger,
+            tracer=tracer,
         )
         self.label_handler = NamespaceLabelHandler(exempt_namespaces)
         outer = self

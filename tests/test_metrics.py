@@ -6,7 +6,11 @@ Reference counterpart: pkg/metrics/ (OpenCensus -> Prometheus exporter on
 """
 
 import json
+import re
+import threading
 import urllib.request
+
+import pytest
 
 from gatekeeper_tpu.metrics import MetricsRegistry, serve_metrics
 
@@ -35,8 +39,28 @@ def test_timed_context_manager():
     reg = MetricsRegistry()
     with reg.timed("op_seconds", kind="x"):
         pass
-    d = reg.snapshot()["distributions"]['op_seconds{kind="x"}']
+    d = reg.snapshot()["distributions"]['op_seconds{kind="x",status="ok"}']
     assert d["count"] == 1 and d["sum"] >= 0
+
+
+def test_timed_records_error_status():
+    """A raising block lands its sample under status=error so timeout
+    latency is separable from success latency."""
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        with reg.timed("op_seconds", kind="x"):
+            raise ValueError("boom")
+    dists = reg.snapshot()["distributions"]
+    assert dists['op_seconds{kind="x",status="error"}']["count"] == 1
+    # a caller-supplied status tag wins (no duplicate label)
+    with reg.timed("op_seconds", kind="x", status="custom"):
+        pass
+    assert (
+        reg.snapshot()["distributions"][
+            'op_seconds{kind="x",status="custom"}'
+        ]["count"]
+        == 1
+    )
 
 
 def test_prometheus_text_format_and_types():
@@ -44,10 +68,14 @@ def test_prometheus_text_format_and_types():
     reg.record("requests", 3, admission_status="allow")
     reg.gauge("constraints", 7)
     reg.observe("request_duration_seconds", 0.5, purpose="webhook")
+    reg.observe("pairs_evaluated", 12.0)  # non-_seconds: summary
     text = reg.prometheus_text()
     assert "# TYPE gatekeeper_requests counter" in text
+    assert "# HELP gatekeeper_requests" in text
     assert "# TYPE gatekeeper_constraints gauge" in text
-    assert "# TYPE gatekeeper_request_duration_seconds summary" in text
+    # *_seconds distributions expose as real histograms by default
+    assert "# TYPE gatekeeper_request_duration_seconds histogram" in text
+    assert "# TYPE gatekeeper_pairs_evaluated summary" in text
     assert 'gatekeeper_requests{admission_status="allow"} 3' in text
     assert "gatekeeper_constraints 7" in text
     # _count/_sum suffixes attach to the metric NAME, before the braces
@@ -59,6 +87,18 @@ def test_prometheus_text_format_and_types():
         'gatekeeper_request_duration_seconds_sum{purpose="webhook"} 0.5'
         in text
     )
+    # _bucket series carry le inside the same label set, >= 8 buckets
+    buckets = [
+        line
+        for line in text.splitlines()
+        if line.startswith("gatekeeper_request_duration_seconds_bucket")
+    ]
+    assert len(buckets) >= 8
+    assert any('le="+Inf"' in b for b in buckets)
+    # docs/metrics.md's distribution contract: _min/_max companions
+    assert 'gatekeeper_request_duration_seconds_min{purpose="webhook"}' in text
+    assert 'gatekeeper_request_duration_seconds_max{purpose="webhook"}' in text
+    assert "gatekeeper_pairs_evaluated_min 12.0" in text
 
 
 def test_prometheus_label_escaping():
@@ -71,6 +111,114 @@ def test_prometheus_label_escaping():
     # no raw newline may survive inside a sample line
     for line in text.splitlines():
         assert line.count('"') % 2 == 0
+
+
+def test_prometheus_label_escaping_roundtrip():
+    """Unescaping the emitted label value (per the exposition format's
+    escape rules) must reproduce the original string exactly."""
+    original = 'quote " backslash \\ newline \n tab\tmix \\" end'
+    reg = MetricsRegistry()
+    reg.record("edge", 1, msg=original)
+    text = reg.prometheus_text()
+    m = re.search(r'gatekeeper_edge\{msg="((?:[^"\\]|\\.)*)"\} 1', text)
+    assert m, text
+    escaped = m.group(1)
+    out, i = [], 0
+    while i < len(escaped):
+        c = escaped[i]
+        if c == "\\":
+            nxt = escaped[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}[nxt])
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    assert "".join(out) == original
+
+
+def test_histogram_bucket_monotonicity_and_inf():
+    """Cumulative _bucket counts must be non-decreasing in le and the
+    +Inf bucket must equal _count (scrapers validate both)."""
+    reg = MetricsRegistry()
+    samples = [0.0001, 0.003, 0.003, 0.08, 1.7, 25.0, 999.0]
+    for v in samples:
+        reg.observe("lat_seconds", v)
+    text = reg.prometheus_text()
+    counts, inf_count, total = [], None, None
+    for line in text.splitlines():
+        m = re.match(r'gatekeeper_lat_seconds_bucket\{le="([^"]+)"\} (\d+)', line)
+        if m:
+            if m.group(1) == "+Inf":
+                inf_count = int(m.group(2))
+            else:
+                counts.append((float(m.group(1)), int(m.group(2))))
+        m = re.match(r"gatekeeper_lat_seconds_count (\d+)", line)
+        if m:
+            total = int(m.group(1))
+    assert len(counts) >= 8
+    assert counts == sorted(counts), "le bounds must ascend"
+    cs = [c for _, c in counts]
+    assert all(a <= b for a, b in zip(cs, cs[1:])), "buckets must cumulate"
+    assert inf_count == total == len(samples)
+    # the 999.0 sample lives only in +Inf
+    assert cs[-1] == len(samples) - 1
+
+
+def test_set_buckets_and_empty_distribution():
+    reg = MetricsRegistry()
+    reg.set_buckets("queue_depth", (1, 10, 100))
+    # configured-but-unsampled: no series, no crash
+    text = reg.prometheus_text()
+    assert "queue_depth" not in text
+    reg.observe("queue_depth", 5)
+    text = reg.prometheus_text()
+    assert 'gatekeeper_queue_depth_bucket{le="1.0"} 0' in text
+    assert 'gatekeeper_queue_depth_bucket{le="10.0"} 1' in text
+    assert 'gatekeeper_queue_depth_bucket{le="+Inf"} 1' in text
+    # empty bounds opt a *_seconds metric OUT of histogram exposition
+    reg.set_buckets("raw_seconds", ())
+    reg.observe("raw_seconds", 0.5)
+    text = reg.prometheus_text()
+    assert "# TYPE gatekeeper_raw_seconds summary" in text
+    assert "gatekeeper_raw_seconds_bucket" not in text
+
+
+def test_concurrent_record_and_exposition():
+    """record/observe racing prometheus_text under threads must never
+    corrupt series or drop counts (the registry lock's contract)."""
+    reg = MetricsRegistry()
+    stop = threading.Event()
+    pages = []
+
+    def writer(i):
+        for j in range(500):
+            reg.record("ops_total", 1, worker=str(i))
+            reg.observe("op_seconds", j * 1e-4, worker=str(i))
+
+    def reader():
+        while not stop.is_set():
+            pages.append(reg.prometheus_text())
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    r.join()
+    snap = reg.snapshot()
+    for i in range(4):
+        assert snap["counters"][f'ops_total{{worker="{i}"}}'] == 500
+        assert (
+            snap["distributions"][f'op_seconds{{worker="{i}"}}']["count"]
+            == 500
+        )
+    # every observed page was internally consistent text
+    for page in pages[-3:]:
+        for line in page.splitlines():
+            assert line.count('"') % 2 == 0
 
 
 def _tpu_client(reg):

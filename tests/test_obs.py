@@ -1,0 +1,385 @@
+"""Observability-layer tests: tracer model, request-trace wiring
+through webhook/bridge/driver, audit sweep traces, /debug/traces
+exposure, and trace_id <-> denial-log correlation.
+
+The acceptance contract (ISSUE 2): a webhook request served through the
+micro-batch bridge produces a trace with >= 4 spans (handler,
+queue_wait, dispatch, render) retrievable from /debug/traces, its
+trace_id appears in the denial log record, and
+request_duration_seconds_bucket series with >= 8 buckets appear in
+/metrics.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget, TpuDriver
+from gatekeeper_tpu.logs import CapturingLogger
+from gatekeeper_tpu.metrics import MetricsRegistry
+from gatekeeper_tpu.obs import Tracer, span_breakdown, start_span
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+REQ_LABELS = """package reqlabels
+
+violation[{"msg": msg}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("missing: %v", [missing])
+}
+"""
+
+
+def template(kind, rego):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    }
+
+
+def constraint(kind, name, params=None):
+    spec = {"match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}}
+    if params is not None:
+        spec["parameters"] = params
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def admission_request(labels=None, uid="u1", name="p"):
+    return {
+        "uid": uid,
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": "CREATE",
+        "name": name,
+        "namespace": "default",
+        "userInfo": {"username": "alice"},
+        "object": {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": "default",
+                "labels": labels or {},
+            },
+            "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+        },
+    }
+
+
+def make_client():
+    cl = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    cl.add_template(template("ReqLabels", REQ_LABELS))
+    cl.add_constraint(
+        constraint("ReqLabels", "need-owner", params={"labels": ["owner"]})
+    )
+    return cl
+
+
+# ---------------------------------------------------------------------------
+# tracer model
+
+
+def test_span_nesting_and_implicit_parenting():
+    tr = Tracer()
+    with tr.start_span("root", k="v") as root:
+        with tr.start_span("child") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+    traces = tr.recent()
+    assert len(traces) == 1
+    spans = {s["name"]: s for s in traces[0]["spans"]}
+    assert spans["root"]["parent_id"] is None
+    assert spans["root"]["attrs"]["k"] == "v"
+    assert spans["child"]["parent_id"] == spans["root"]["span_id"]
+    assert spans["child"]["duration_ms"] <= spans["root"]["duration_ms"]
+
+
+def test_record_span_cross_thread():
+    """The micro-batch shape: a worker thread stamps pre-timed spans
+    into a request trace via the carried SpanContext."""
+    tr = Tracer()
+    with tr.start_span("handler") as root:
+        ctx = root.context
+
+        def worker():
+            d = tr.record_span("dispatch", 10.0, 10.5, parent=ctx, n=3)
+            tr.record_span("render", 10.4, 10.5, parent=d)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    (trace,) = tr.recent()
+    names = {s["name"] for s in trace["spans"]}
+    assert names == {"handler", "dispatch", "render"}
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert by_name["dispatch"]["attrs"]["n"] == 3
+    assert by_name["dispatch"]["duration_ms"] == 500.0
+    assert (
+        by_name["render"]["parent_id"] == by_name["dispatch"]["span_id"]
+    )
+
+
+def test_error_status_and_noop_span():
+    tr = Tracer()
+    with pytest.raises(RuntimeError):
+        with tr.start_span("boom"):
+            raise RuntimeError("x")
+    (trace,) = tr.recent()
+    assert trace["spans"][0]["status"] == "error"
+    assert "x" in trace["spans"][0]["attrs"]["error"]
+    # tracer=None call sites cost nothing and never fail
+    with start_span(None, "anything", k=1) as sp:
+        sp.set_attr(more=2)
+    assert sp.context is None
+
+
+def test_ring_retention_bounded():
+    tr = Tracer(max_traces=5)
+    for i in range(20):
+        with tr.start_span(f"op{i}"):
+            pass
+    traces = tr.recent(100)
+    assert len(traces) == 5
+    # newest first
+    assert traces[0]["spans"][0]["name"] == "op19"
+    assert tr.get(traces[0]["trace_id"]) is not None
+    doc = json.loads(tr.export_json(2))
+    assert len(doc["traces"]) == 2
+
+
+def test_span_breakdown_aggregation():
+    tr = Tracer()
+    for ms in (1, 2, 100):
+        with tr.start_span("handler") as root:
+            tr.record_span(
+                "dispatch", 0.0, ms / 1e3, parent=root.context
+            )
+    out = span_breakdown(tr.recent())
+    assert out["dispatch"]["count"] == 3
+    assert out["dispatch"]["max_ms"] == 100.0
+    assert out["dispatch"]["p50_ms"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# webhook end-to-end (the acceptance contract)
+
+
+def test_webhook_trace_end_to_end():
+    from gatekeeper_tpu.webhook.server import WebhookServer
+
+    tracer = Tracer()
+    reg = MetricsRegistry()
+    log = CapturingLogger()
+    server = WebhookServer(
+        make_client(), TARGET, window_ms=1.0, tracer=tracer,
+        metrics=reg, log_denies=True, logger=log,
+    )
+    server.start()
+    try:
+        body = json.dumps(
+            {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": admission_request(),
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/admit",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            doc = json.loads(resp.read())
+        assert doc["response"]["allowed"] is False
+    finally:
+        server.stop()
+
+    traces = tracer.recent()
+    assert traces, "request produced no trace"
+    spans = traces[0]["spans"]
+    names = [s["name"] for s in spans]
+    # >= 4 spans: handler (root), queue_wait, dispatch, render
+    for want in ("handler", "queue_wait", "dispatch", "render"):
+        assert want in names, names
+    assert len(spans) >= 4
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["handler"]["parent_id"] is None
+    assert by_name["handler"]["attrs"]["admission_status"] == "deny"
+    assert by_name["dispatch"]["attrs"]["batch_size"] >= 1
+    # queue_wait and dispatch parent back to the handler root
+    assert (
+        by_name["queue_wait"]["parent_id"]
+        == by_name["handler"]["span_id"]
+    )
+
+    # trace_id correlation: the denial log record and the in-memory
+    # denied_log both name this trace
+    tid = traces[0]["trace_id"]
+    denies = [
+        r for r in log.records if r.get("msg") == "denied admission"
+    ]
+    assert denies and denies[0]["trace_id"] == tid
+    assert server.handler.denied_log[0]["trace_id"] == tid
+
+    # histogram contract: real _bucket series, >= 8 buckets
+    text = reg.prometheus_text()
+    buckets = [
+        line
+        for line in text.splitlines()
+        if line.startswith("gatekeeper_request_duration_seconds_bucket")
+    ]
+    assert len(buckets) >= 8
+    assert any('le="+Inf"' in b for b in buckets)
+    # micro-batch telemetry recorded alongside
+    assert "gatekeeper_webhook_batch_size_count" in text
+
+
+def test_handler_span_without_batcher():
+    """Plain ValidationHandler (no bridge): handler -> dispatch with
+    route=serial."""
+    from gatekeeper_tpu.webhook import ValidationHandler
+
+    tracer = Tracer()
+    handler = ValidationHandler(
+        make_client(), TARGET, tracer=tracer, log_denies=True
+    )
+    resp = handler.handle(admission_request())
+    assert not resp.allowed
+    (trace,) = tracer.recent(1)
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert by_name["dispatch"]["attrs"]["route"] == "serial"
+    assert handler.denied_log[0]["trace_id"] == trace["trace_id"]
+
+
+# ---------------------------------------------------------------------------
+# audit sweep traces
+
+
+def test_audit_sweep_trace():
+    from gatekeeper_tpu.audit import AuditManager
+
+    cl = make_client()
+    cl.add_data(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "bad", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+        }
+    )
+    tracer = Tracer()
+    reg = MetricsRegistry()
+    mgr = AuditManager(cl, TARGET, tracer=tracer, metrics=reg)
+    report = mgr.audit()
+    assert report.total_violations >= 1
+    (trace,) = tracer.recent(1)
+    names = [s["name"] for s in trace["spans"]]
+    for want in ("audit_sweep", "dispatch", "aggregate", "status_write"):
+        assert want in names, names
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert by_name["audit_sweep"]["parent_id"] is None
+    assert by_name["audit_sweep"]["attrs"]["from_cache"] is True
+    assert by_name["aggregate"]["attrs"]["violations"] >= 1
+    # phase metrics mirror the span taxonomy
+    dists = reg.snapshot()["distributions"]
+    for phase in ("dispatch", "aggregate", "status_write"):
+        assert (
+            dists[f'audit_phase_seconds{{phase="{phase}"}}']["count"] == 1
+        )
+
+
+# ---------------------------------------------------------------------------
+# runner: /debug/traces + /readyz driver stats
+
+
+def test_runner_debug_traces_and_readyz_driver_stats():
+    from gatekeeper_tpu.control import FakeCluster, Runner
+
+    cluster = FakeCluster()
+    cluster.apply(template("ReqLabels", REQ_LABELS))
+    cluster.apply(constraint("ReqLabels", "need-owner",
+                             params={"labels": ["owner"]}))
+    client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    runner = Runner(
+        cluster, client, TARGET,
+        operations=("webhook",), audit_interval=3600.0,
+    )
+    runner.start()
+    try:
+        assert runner.wait_ready(30)
+        body = json.dumps(
+            {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": admission_request(),
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{runner.webhook.port}/v1/admit",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            doc = json.loads(resp.read())
+        assert doc["response"]["allowed"] is False
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{runner.readyz_port}/debug/traces?n=10",
+            timeout=10,
+        ) as resp:
+            traces = json.loads(resp.read())["traces"]
+        admission = [
+            t
+            for t in traces
+            if any(s["name"] == "handler" for s in t["spans"])
+        ]
+        assert admission, traces
+        names = {s["name"] for s in admission[0]["spans"]}
+        assert {"handler", "queue_wait", "dispatch", "render"} <= names
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{runner.readyz_port}/readyz", timeout=10
+        ) as resp:
+            ready = json.loads(resp.read())
+        drv = ready["stats"]["driver"]
+        assert "fallback_codes" in drv
+        assert drv["analyzer_mismatches"] == 0
+        assert "cold_batches" in drv
+    finally:
+        runner.stop()
+
+
+def test_serve_metrics_debug_traces():
+    from gatekeeper_tpu.metrics import serve_metrics
+
+    tracer = Tracer()
+    with tracer.start_span("op"):
+        pass
+    reg = MetricsRegistry()
+    httpd = serve_metrics(reg, port=0, tracer=tracer)
+    try:
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces", timeout=5
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["traces"][0]["spans"][0]["name"] == "op"
+    finally:
+        httpd.shutdown()
