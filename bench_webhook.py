@@ -291,6 +291,116 @@ def run_webhook_bench(n_requests=10_000, n_constraints=50, err=sys.stderr):
     return result
 
 
+def _mutator_mix(n_mutators):
+    """Synthesized mutator load: cycles the three kinds with varied
+    match specs so screening exercises the kernel's dimensions."""
+    out = []
+    for i in range(n_mutators):
+        which = i % 3
+        if which == 0:
+            out.append({
+                "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+                "kind": "AssignMetadata",
+                "metadata": {"name": f"bm-label-{i}"},
+                "spec": {
+                    "match": {"scope": "Namespaced"},
+                    "location": f"metadata.labels.bench-{i}",
+                    "parameters": {"assign": {"value": f"v{i}"}},
+                },
+            })
+        elif which == 1:
+            out.append({
+                "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+                "kind": "Assign",
+                "metadata": {"name": f"bm-assign-{i}"},
+                "spec": {
+                    "applyTo": [{"groups": [""], "versions": ["v1"],
+                                 "kinds": ["Pod"]}],
+                    "match": {"kinds": [{"apiGroups": [""],
+                                         "kinds": ["Pod"]}],
+                              "namespaces": [f"ns{j}" for j in range(11)]},
+                    "location": "spec.containers[name: *].imagePullPolicy",
+                    "parameters": {"assign": {"value": "Always"}},
+                },
+            })
+        else:
+            out.append({
+                "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+                "kind": "ModifySet",
+                "metadata": {"name": f"bm-set-{i}"},
+                "spec": {
+                    "applyTo": [{"groups": [""], "versions": ["v1"],
+                                 "kinds": ["Pod"]}],
+                    "match": {"kinds": [{"apiGroups": [""],
+                                         "kinds": ["Pod"]}]},
+                    "location": "spec.containers[name: main].args",
+                    "parameters": {"operation": "merge",
+                                   "values": {"fromList": [f"--flag{i}"]}},
+                },
+            })
+    return out
+
+
+def run_mutate_bench(n_requests=10_000, n_mutators=30, err=sys.stderr):
+    """The mutate-plane replay (`--mutate`): p50/p99/throughput of
+    /v1/mutate's handler path at the measured concurrencies, plus the
+    per-span breakdown (queue_wait / screen_dispatch / apply_fixpoint /
+    render_patch) so the next BENCH round captures the second admission
+    plane with the same cost-center attribution as validation."""
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.mutation import MutationSystem
+    from gatekeeper_tpu.obs import Tracer, span_breakdown
+    from gatekeeper_tpu.webhook.mutate import MutateBatcher, MutationHandler
+
+    metrics = MetricsRegistry()
+    tracer = Tracer(max_traces=8192)
+    system = MutationSystem(metrics=metrics)
+    for m in _mutator_mix(n_mutators):
+        system.upsert(m)
+    batcher = MutateBatcher(
+        system, window_ms=2.0, metrics=metrics, tracer=tracer
+    )
+    handler = MutationHandler(
+        batcher, metrics=metrics, request_timeout=60, tracer=tracer
+    )
+    batcher.start()
+    out = []
+    try:
+        # warm the screen's jit buckets across both concurrency profiles
+        replay(handler, [make_request(i) for i in range(256)], 64)
+        replay(handler, [make_request(i) for i in range(512)], 128)
+        tracer.clear()
+        for conc, n_sub in ((8, max(400, n_requests // 25)),
+                            (128, max(1500, n_requests // 6))):
+            batcher.batches_dispatched = 0
+            batcher.requests_batched = 0
+            requests = [make_request(i) for i in range(n_sub)]
+            r = replay(handler, requests, conc)
+            del r["denied"]  # mutate allows; patch presence is the signal
+            r["batch_occupancy"] = round(
+                batcher.requests_batched
+                / max(1, batcher.batches_dispatched),
+                1,
+            )
+            r["screen_dispatches"] = system.screen_dispatches
+            out.append(r)
+            print(f"mutate replay: {r}", file=err)
+        breakdown = span_breakdown(tracer.recent(8192))
+        print(f"mutate span breakdown (ms): {breakdown}", file=err)
+    finally:
+        batcher.stop()
+    snap = metrics.snapshot()
+    return {
+        "mutators": n_mutators,
+        "replays": out,
+        "span_breakdown_ms": breakdown,
+        "fixpoint_iterations": snap["distributions"].get(
+            "mutation_fixpoint_iterations", {}
+        ),
+        "patch_bytes": snap["distributions"].get("mutation_patch_bytes", {}),
+    }
+
+
 # the reference harness's constraint-count ladder
 # (pkg/webhook/policy_benchmark_test.go:265-276)
 LADDER = (5, 10, 50, 100, 200, 1000, 2000)
@@ -582,6 +692,11 @@ if __name__ == "__main__":
     if "--ladder" in sys.argv:
         rows, skipped = run_constraint_ladder()
         print(json.dumps({"rungs": rows, "skipped": skipped}))
+    elif "--mutate" in sys.argv:
+        pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n_req = int(pos[0]) if pos else 10_000
+        n_mut = int(pos[1]) if len(pos) > 1 else 30
+        print(json.dumps(run_mutate_bench(n_req, n_mut)))
     else:
         n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
         n_con = int(sys.argv[2]) if len(sys.argv) > 2 else 50

@@ -64,6 +64,12 @@ DEFAULT_VALUES: Dict[str, Any] = {
     # fail-open (policy.go:80): audit is the backstop
     "webhookFailurePolicy": "Ignore",
     "vwhName": "gatekeeper-validating-webhook-configuration",
+    # mutation plane (/v1/mutate): fail-open like validation — a missed
+    # mutation is corrected by nothing, but blocking all admission on a
+    # mutation-webhook outage is worse (reference default Ignore)
+    "disableMutation": False,
+    "mutationFailurePolicy": "Ignore",
+    "mwhName": "gatekeeper-mutating-webhook-configuration",
     "minDeviceBatch": None,  # GATEKEEPER_TPU_MIN_DEVICE_BATCH override
     "nodeSelector": {},  # webhook pods
     "tolerations": [],
@@ -208,6 +214,17 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
              "constraintpodstatuses", "Namespaced", ["v1beta1"]),
         _crd("status.gatekeeper.sh", "ConstraintTemplatePodStatus",
              "constrainttemplatepodstatuses", "Namespaced", ["v1beta1"]),
+        _crd("status.gatekeeper.sh", "MutatorPodStatus",
+             "mutatorpodstatuses", "Namespaced", ["v1beta1"]),
+        # the mutation CRDs (pkg/mutation in the reference; the TPU
+        # build screens their Match specs with the same kernel as
+        # constraints)
+        _crd("mutations.gatekeeper.sh", "Assign", "assign", "Cluster",
+             ["v1alpha1"]),
+        _crd("mutations.gatekeeper.sh", "AssignMetadata", "assignmetadata",
+             "Cluster", ["v1alpha1"]),
+        _crd("mutations.gatekeeper.sh", "ModifySet", "modifyset", "Cluster",
+             ["v1alpha1"]),
         {
             "apiVersion": "v1",
             "kind": "Namespace",
@@ -240,6 +257,7 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
                     "apiGroups": [
                         "config.gatekeeper.sh",
                         "constraints.gatekeeper.sh",
+                        "mutations.gatekeeper.sh",
                         "templates.gatekeeper.sh",
                         "status.gatekeeper.sh",
                     ],
@@ -260,7 +278,8 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
                 },
                 {
                     "apiGroups": ["admissionregistration.k8s.io"],
-                    "resources": ["validatingwebhookconfigurations"],
+                    "resources": ["validatingwebhookconfigurations",
+                                  "mutatingwebhookconfigurations"],
                     "verbs": ["create", "get", "list", "patch", "update",
                               "watch"],
                 },
@@ -377,6 +396,20 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
         _deployment(v, "gatekeeper-audit", "audit", audit_pod, 1)
     )
 
+    # one namespace-exclusion selector shared VERBATIM by the validating
+    # and mutating configs (namespaces opted out with the ignore label
+    # must skip BOTH planes, or a mutated-but-unvalidated object slips
+    # through the gap)
+    def _ns_exclusions():
+        return {
+            "matchExpressions": [
+                {
+                    "key": "admission.gatekeeper.sh/ignore",
+                    "operator": "DoesNotExist",
+                }
+            ]
+        }
+
     if not v["disableValidatingWebhook"]:
         docs.append(
             {
@@ -390,6 +423,7 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
                         "sideEffects": "None",
                         "failurePolicy": v["webhookFailurePolicy"],
                         "timeoutSeconds": v["webhookTimeoutSeconds"],
+                        "namespaceSelector": _ns_exclusions(),
                         "clientConfig": {
                             # caBundle injected + self-healed by the
                             # running pods (--vwh-name, CaBundleInjector)
@@ -432,15 +466,51 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
                 ],
             }
         )
+    if not v["disableMutation"]:
+        docs.append(
+            {
+                "apiVersion": "admissionregistration.k8s.io/v1",
+                "kind": "MutatingWebhookConfiguration",
+                "metadata": {"name": v["mwhName"]},
+                "webhooks": [
+                    {
+                        "name": "mutation.gatekeeper.sh",
+                        "admissionReviewVersions": ["v1"],
+                        "sideEffects": "None",
+                        "failurePolicy": v["mutationFailurePolicy"],
+                        "timeoutSeconds": v["webhookTimeoutSeconds"],
+                        # exclusions MATCH the validating config above
+                        "namespaceSelector": _ns_exclusions(),
+                        "reinvocationPolicy": "Never",
+                        "clientConfig": {
+                            "service": {
+                                "name": "gatekeeper-webhook-service",
+                                "namespace": ns,
+                                "path": "/v1/mutate",
+                            }
+                        },
+                        "rules": [
+                            {
+                                "apiGroups": ["*"],
+                                "apiVersions": ["*"],
+                                "operations": ["CREATE", "UPDATE"],
+                                "resources": ["*"],
+                            }
+                        ],
+                    },
+                ],
+            }
+        )
     return [copy.deepcopy(d) for d in docs]
 
 
 HEADER = """\
 # GENERATED by deploy/render.py — edit values there, not this file.
 # The operations-split deployment (webhook CPU replicas + one audit pod
-# on a TPU node), scoped RBAC, base CRDs, Service, and the fail-open
-# ValidatingWebhookConfiguration. See deploy/render.py's docstring for
-# the design rationale and charts/gatekeeper parity notes.
+# on a TPU node), scoped RBAC, base CRDs (incl. the mutation kinds),
+# Service, and the fail-open Validating + Mutating webhook
+# configurations (shared namespace exclusions). See deploy/render.py's
+# docstring for the design rationale and charts/gatekeeper parity notes.
 """
 
 

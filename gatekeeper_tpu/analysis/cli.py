@@ -1,12 +1,21 @@
-"""Offline template linting CLI.
+"""Offline template + mutator linting CLI.
 
     python -m gatekeeper_tpu.analysis deploy/ [more paths...]
         [--json] [--baseline FILE] [--write-baseline FILE] [--strict]
+    python -m gatekeeper_tpu.analysis mutators deploy/ [more paths...]
+        [--json] [--baseline FILE] [--write-baseline FILE]
 
-Scans the given files/directories for ConstraintTemplate YAML documents
-(directories recurse over *.yaml / *.yml; explicit *.rego file args are
-analyzed as a bare template entry module), runs the static
-vectorizability analyzer on each, and prints one report per template.
+Default mode scans the given files/directories for ConstraintTemplate
+YAML documents (directories recurse over *.yaml / *.yml; explicit
+*.rego file args are analyzed as a bare template entry module), runs
+the static vectorizability analyzer on each, and prints one report per
+template.
+
+`mutators` mode scans for Assign/AssignMetadata/ModifySet documents,
+reports location-path parse errors and cross-mutator schema conflicts
+with stable GK-M0xx codes (docs/mutation.md), and compares against a
+baseline manifest ({"mutators": {id: [codes]}}) so CI pins the shipped
+example mutators clean.
 
 Exit status:
   0  every template analyzed, no INVALID verdicts, no baseline
@@ -92,7 +101,121 @@ def _worse(a: str, b: str) -> bool:
     return VERDICT_ORDER.index(a) > VERDICT_ORDER.index(b)
 
 
+def _iter_mutator_docs(path: str):
+    import yaml
+
+    from ..mutation.lint import is_mutator_doc
+
+    with open(path) as f:
+        try:
+            docs = list(yaml.safe_load_all(f))
+        except yaml.YAMLError as e:
+            raise SystemExit(f"error: {path}: YAML parse error: {e}")
+    for doc in docs:
+        if is_mutator_doc(doc):
+            yield path, doc
+
+
+def collect_mutators(paths: List[str]) -> List[Tuple[str, Dict[str, Any]]]:
+    out: List[Tuple[str, Dict[str, Any]]] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for fn in sorted(files):
+                    if fn.endswith((".yaml", ".yml")):
+                        out.extend(
+                            _iter_mutator_docs(os.path.join(root, fn))
+                        )
+        elif p.endswith((".yaml", ".yml")):
+            out.extend(_iter_mutator_docs(p))
+        else:
+            raise SystemExit(f"error: unsupported path {p!r}")
+    return out
+
+
+def run_mutators(argv: List[str]) -> int:
+    """`mutators` mode: GK-M0xx lint + baseline enforcement."""
+    from ..mutation.lint import lint_mutators
+
+    ap = argparse.ArgumentParser(
+        prog="python -m gatekeeper_tpu.analysis mutators",
+        description="Offline mutator linter (path grammar + conflicts)",
+    )
+    ap.add_argument("paths", nargs="+", help="mutator YAML files or dirs")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--baseline", help="code manifest to compare against")
+    ap.add_argument(
+        "--write-baseline", help="write the current codes to FILE"
+    )
+    args = ap.parse_args(argv)
+
+    entries = collect_mutators(args.paths)
+    if not entries:
+        print("no mutators found", file=sys.stderr)
+        return 2
+
+    lints = lint_mutators(entries)
+
+    failures: List[str] = []
+    baseline: Dict[str, List[str]] = {}
+    if args.baseline:
+        with open(args.baseline) as f:
+            baseline = (json.load(f) or {}).get("mutators", {})
+        for lint in lints:
+            want = baseline.get(lint.id)
+            if want is None:
+                continue  # new mutator: allowed
+            new_codes = sorted(set(lint.codes) - set(want))
+            if new_codes:
+                failures.append(
+                    f"{lint.id}: new diagnostics vs baseline: "
+                    f"{', '.join(new_codes)}"
+                )
+    else:
+        # no baseline: any diagnostic is a failure (lint mode)
+        for lint in lints:
+            if not lint.ok:
+                failures.append(lint.render())
+
+    if args.write_baseline:
+        manifest = {
+            "mutators": {
+                lint.id: sorted(lint.codes) for lint in lints
+            }
+        }
+        with open(args.write_baseline, "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "mutators": [lint.to_dict() for lint in lints],
+                    "failures": failures,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for lint in lints:
+            print(f"[{lint.source}] {lint.render()}")
+        if failures:
+            print("\nFAIL:", file=sys.stderr)
+            for f_ in failures:
+                print(f"  {f_}", file=sys.stderr)
+        else:
+            n_ok = sum(1 for lint in lints if lint.ok)
+            print(
+                f"\nOK: {len(lints)} mutator(s): clean={n_ok} "
+                f"flagged={len(lints) - n_ok}"
+            )
+    return 1 if failures else 0
+
+
 def run(argv: List[str]) -> int:
+    if argv and argv[0] == "mutators":
+        return run_mutators(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m gatekeeper_tpu.analysis",
         description="Static vectorizability linter for ConstraintTemplates",

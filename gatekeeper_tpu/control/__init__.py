@@ -26,6 +26,8 @@ from .controllers import (  # noqa: F401
     ConfigController,
     ConstraintController,
     ControllerSwitch,
+    MUTATOR_GVKS,
+    MutatorController,
     SyncController,
     TemplateController,
     TEMPLATE_GVK,

@@ -28,6 +28,8 @@ from .controllers import (
     ConfigController,
     ConstraintController,
     ControllerSwitch,
+    MUTATOR_GVKS,
+    MutatorController,
     SyncController,
     TemplateController,
     TEMPLATE_GVK,
@@ -199,6 +201,24 @@ class Runner:
         self._sync_registrar = self.watch_mgr.new_registrar(
             "sync-controller", self.sync_controller.sink
         )
+        # mutation plane: the system is always built (cheap when no
+        # mutators exist); the webhook serves /v1/mutate through it and
+        # the controller keeps it synced with the three mutator GVKs
+        from ..mutation import MutationSystem
+
+        self.mutation_system = MutationSystem(
+            metrics=metrics, logger=self.log
+        )
+        self.mutator_controller = MutatorController(
+            self.mutation_system,
+            switch=self.switch,
+            metrics=metrics,
+            status=self.status_writer,
+            logger=self.log,
+        )
+        self._mutator_registrar = self.watch_mgr.new_registrar(
+            "mutator-controller", self.mutator_controller.sink
+        )
         self.config_controller = ConfigController(
             client,
             self._sync_registrar,
@@ -208,6 +228,8 @@ class Runner:
             switch=self.switch,
             metrics=metrics,
             trace_config=self.trace_config,
+            mutation_system=self.mutation_system,
+            mutation_registrar=self._mutator_registrar,
         )
         self._config_registrar = self.watch_mgr.new_registrar(
             "config-controller", self.config_controller.sink
@@ -291,6 +313,8 @@ class Runner:
         # sync watches), status kinds for the aggregator
         self._template_registrar.add_watch(TEMPLATE_GVK)
         self._config_registrar.add_watch(CONFIG_GVK)
+        for gvk in MUTATOR_GVKS:
+            self._mutator_registrar.add_watch(gvk)
         if OPERATION_STATUS in self.operations:
             self._status_registrar.add_watch(TEMPLATE_STATUS_GVK)
             self._status_registrar.add_watch(CONSTRAINT_STATUS_GVK)
@@ -313,6 +337,7 @@ class Runner:
                 log_denies=self.log_denies,
                 logger=self.log.with_values(process="webhook"),
                 tracer=self.tracer,
+                mutation_system=self.mutation_system,
                 cert_dir=self.cert_dir,
                 bind_addr=self.bind_addr,
             )

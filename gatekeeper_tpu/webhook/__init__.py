@@ -1,12 +1,15 @@
 """Admission webhook layer.
 
 Counterpart of pkg/webhook/: the validating handler (policy.go), the
-namespace-label guard (namespacelabel.go), and — new to the TPU build —
+namespace-label guard (namespacelabel.go), the mutating handler
+(mutate.py over gatekeeper_tpu/mutation/), and — new to the TPU build —
 the micro-batching bridge that coalesces concurrent AdmissionReviews
-into one fused device dispatch (SURVEY §2.4 row 3).
+into one fused device dispatch (SURVEY §2.4 row 3) on BOTH planes
+(validate: Client.review_many; mutate: MutationSystem.screen).
 """
 
 from .policy import AdmissionResponse, TraceConfig, ValidationHandler  # noqa: F401
 from .certs import CertRotator  # noqa: F401
 from .namespacelabel import IGNORE_LABEL, NamespaceLabelHandler  # noqa: F401
-from .server import MicroBatcher, WebhookServer  # noqa: F401
+from .server import MicroBatcher, WebhookServer, review_envelope  # noqa: F401
+from .mutate import MutateBatcher, MutationHandler  # noqa: F401

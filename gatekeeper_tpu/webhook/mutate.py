@@ -1,0 +1,264 @@
+"""The `/v1/mutate` serving path: micro-batched, kernel-screened.
+
+`MutateBatcher` rides `MicroBatcher`'s coalescing worker loop (same
+window/max_batch/submit semantics as the validation plane) but its
+dispatch is the mutation pipeline:
+
+  1. **screen** — ONE `match_matrix` device call for the whole batch
+     decides which mutators apply to which requests (mutator Match
+     specs reuse the constraint match encoding end-to-end);
+  2. **apply** — CPU fixpoint application for screened-in pairs only
+     (`MutationSystem.apply`; ConvergenceError fails THAT request, the
+     object is never admitted non-converged);
+  3. **render** — RFC 6902 JSONPatch diff per request.
+
+Each traced request gets queue_wait / screen_dispatch / apply_fixpoint
+/ render_patch spans stamped by the batch worker (the PR-2 span
+conventions), and the Prometheus series in docs/metrics.md §Mutation
+account the same pipeline.
+
+`MutationHandler` is the policy layer: service-account bypass, excluded
+namespaces, operation filtering, metrics, and the AdmissionResponse
+with the base64 JSONPatch payload.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Tuple
+
+from ..mutation import ConvergenceError, MutationApplyError, json_patch
+from .policy import SERVICE_ACCOUNT, AdmissionResponse
+from .server import DEFAULT_REQUEST_TIMEOUT, MicroBatcher
+
+# mutators act on the incoming object; DELETE carries none
+_MUTATE_OPERATIONS = ("CREATE", "UPDATE", "")
+
+
+class MutateBatcher(MicroBatcher):
+    """MicroBatcher whose fused dispatch is screen→apply→render over a
+    MutationSystem instead of Client.review_many."""
+
+    def __init__(
+        self,
+        system,
+        window_ms: float = 2.0,
+        max_batch: int = 256,
+        namespace_getter=None,
+        metrics=None,
+        tracer=None,
+    ):
+        super().__init__(
+            client=None,
+            target="mutation",
+            window_ms=window_ms,
+            max_batch=max_batch,
+            namespace_getter=namespace_getter,
+            metrics=metrics,
+            tracer=tracer,
+        )
+        self.system = system
+
+    # -- the mutate dispatch -------------------------------------------------
+
+    def _dispatch(self, batch: List[Tuple[Dict[str, Any], Any, Any, Tuple]]):
+        wall0, t0 = time.time(), time.perf_counter()
+        reviews = []
+        for request, _, _, _ in batch:
+            review = dict(request)
+            ns_obj = None
+            namespace = request.get("namespace", "")
+            if namespace and self.namespace_getter is not None:
+                ns_obj = self.namespace_getter(namespace)
+            if ns_obj is not None:
+                review["_unstable"] = {"namespace": ns_obj}
+            reviews.append(review)
+
+        t_scr = time.perf_counter()
+        try:
+            muts, matrix = self.system.screen(reviews)
+            route = "batched"
+        except Exception:
+            # device-screen failure degrades to the host oracle — the
+            # mutation plane keeps answering (fail-open on the SCREEN,
+            # never on convergence)
+            self.batch_failures += 1
+            if self.metrics is not None:
+                self.metrics.record("mutation_batch_failures_total", 1)
+            muts, matrix = self.system.screen_host(reviews)
+            route = "fallback"
+        screen_s = time.perf_counter() - t_scr
+
+        self.batches_dispatched += 1
+        self.requests_batched += len(batch)
+        if self.metrics is not None:
+            self.metrics.record("mutation_batches_total", 1)
+            self.metrics.observe("mutation_screen_batch_size", len(batch))
+
+        wall_scr_end = wall0 + (time.perf_counter() - t0)
+        for i, ((request, fut, ctx, (sub_wall, _)), review) in enumerate(
+            zip(batch, reviews)
+        ):
+            selected = [m for j, m in enumerate(muts) if matrix[j, i]]
+            obj = review.get("object")
+            apply_s = render_s = 0.0
+            iters = 0
+            try:
+                if not isinstance(obj, dict) or not selected:
+                    patch: List[Dict[str, Any]] = []
+                else:
+                    t_a = time.perf_counter()
+                    mutated, iters = self.system.apply(
+                        obj, review, selected
+                    )
+                    apply_s = time.perf_counter() - t_a
+                    t_r = time.perf_counter()
+                    patch = json_patch(obj, mutated)
+                    render_s = time.perf_counter() - t_r
+                if self.metrics is not None:
+                    if iters:
+                        self.metrics.observe(
+                            "mutation_fixpoint_iterations", iters
+                        )
+                    if patch:
+                        self.metrics.observe(
+                            "mutation_patch_bytes",
+                            len(json.dumps(patch)),
+                        )
+                fut.set_result(patch)
+            except (ConvergenceError, MutationApplyError) as e:
+                if self.metrics is not None and isinstance(
+                    e, ConvergenceError
+                ):
+                    self.metrics.record("mutation_divergence_total", 1)
+                fut.set_exception(e)
+            except Exception as e:
+                fut.set_exception(e)
+            self._record_mutate_spans(
+                ctx, sub_wall, wall0, wall_scr_end, screen_s,
+                apply_s, render_s, len(batch), len(selected), route,
+            )
+
+    def _record_mutate_spans(
+        self, ctx, sub_wall, wall0, wall_scr_end, screen_s,
+        apply_s, render_s, batch_size, n_mutators, route,
+    ) -> None:
+        """Span taxonomy for the mutate plane: queue_wait (submit →
+        dispatch), screen_dispatch (the shared kernel screen, recorded
+        into every member trace), then per-request apply_fixpoint and
+        render_patch laid out sequentially after the screen window."""
+        if self.tracer is None or ctx is None:
+            return
+        self.tracer.record_span("queue_wait", sub_wall, wall0, parent=ctx)
+        self.tracer.record_span(
+            "screen_dispatch", wall0, wall0 + screen_s, parent=ctx,
+            batch_size=batch_size, route=route,
+        )
+        cursor = wall_scr_end
+        self.tracer.record_span(
+            "apply_fixpoint", cursor, cursor + apply_s, parent=ctx,
+            mutators=n_mutators,
+        )
+        cursor += apply_s
+        self.tracer.record_span(
+            "render_patch", cursor, cursor + render_s, parent=ctx
+        )
+
+
+class MutationHandler:
+    """Mutating-admission policy layer over the batcher (the mutation
+    webhook's counterpart of ValidationHandler)."""
+
+    def __init__(
+        self,
+        batcher: MutateBatcher,
+        excluder=None,
+        metrics=None,
+        request_timeout: float = DEFAULT_REQUEST_TIMEOUT,
+        logger=None,
+        tracer=None,
+    ):
+        from ..logs import null_logger
+
+        self.batcher = batcher
+        self.excluder = excluder
+        self.metrics = metrics
+        self.request_timeout = request_timeout
+        self.log = logger if logger is not None else null_logger()
+        self.tracer = tracer
+
+    def handle(self, request: Dict[str, Any]) -> AdmissionResponse:
+        from ..obs import start_span
+
+        t0 = time.perf_counter()
+        kind = request.get("kind") or {}
+        with start_span(
+            self.tracer,
+            "mutate_handler",
+            resource_kind=kind.get("kind", ""),
+            resource_namespace=request.get("namespace", ""),
+            resource_name=request.get("name", ""),
+            operation=request.get("operation", ""),
+        ) as span:
+            resp = self._handle(request, span)
+            span.set_attr(
+                mutation_status=(
+                    "error"
+                    if not resp.allowed
+                    else ("mutated" if resp.patch else "unchanged")
+                ),
+                code=resp.code,
+            )
+        if self.metrics is not None:
+            status = (
+                "error"
+                if not resp.allowed
+                else ("mutated" if resp.patch else "unchanged")
+            )
+            self.metrics.record(
+                "mutation_request_count", 1, mutation_status=status
+            )
+            self.metrics.observe(
+                "mutation_request_duration_seconds",
+                time.perf_counter() - t0,
+                mutation_status=status,
+            )
+        return resp
+
+    def _handle(self, request: Dict[str, Any], span=None) -> AdmissionResponse:
+        from ..control import PROCESS_WEBHOOK
+
+        user = (request.get("userInfo") or {}).get("username", "")
+        if user == SERVICE_ACCOUNT:
+            return AdmissionResponse(True, "Gatekeeper does not self-manage")
+        if request.get("operation", "") not in _MUTATE_OPERATIONS:
+            return AdmissionResponse(True, "")
+        namespace = request.get("namespace", "")
+        if (
+            namespace
+            and self.excluder is not None
+            and self.excluder.is_namespace_excluded(
+                PROCESS_WEBHOOK, namespace
+            )
+        ):
+            return AdmissionResponse(
+                True, "Namespace is set to be ignored by Gatekeeper config"
+            )
+        try:
+            patch = self.batcher.submit(
+                request, span_ctx=getattr(span, "context", None)
+            ).result(timeout=self.request_timeout)
+        except (ConvergenceError, MutationApplyError) as e:
+            # NEVER admit a non-converged / half-mutable object
+            self.log.error(
+                "mutation failed",
+                process="mutation",
+                err=e,
+                resource_name=request.get("name", ""),
+                resource_namespace=namespace,
+            )
+            return AdmissionResponse(False, str(e), code=500)
+        except Exception as e:
+            return AdmissionResponse(False, str(e), code=500)
+        return AdmissionResponse(True, "", patch=patch or None)

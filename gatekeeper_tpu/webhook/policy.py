@@ -79,6 +79,9 @@ class AdmissionResponse:
     allowed: bool
     message: str = ""
     code: int = 200
+    # RFC 6902 ops from the mutation plane; rendered as base64 JSON with
+    # patchType: JSONPatch (the apiserver contract). None/[] = no patch.
+    patch: Optional[List[Dict[str, Any]]] = None
 
     def to_dict(self, uid: Optional[str] = None) -> Dict[str, Any]:
         out: Dict[str, Any] = {"allowed": self.allowed}
@@ -89,6 +92,14 @@ class AdmissionResponse:
                 "code": self.code,
                 "message": self.message,
             }
+        if self.patch:
+            import base64
+            import json as _json
+
+            out["patchType"] = "JSONPatch"
+            out["patch"] = base64.b64encode(
+                _json.dumps(self.patch).encode()
+            ).decode()
         return out
 
 

@@ -37,6 +37,21 @@ from .policy import AdmissionResponse, ValidationHandler
 DEFAULT_REQUEST_TIMEOUT = 10.0
 
 
+def review_envelope(
+    review: Dict[str, Any], request: Dict[str, Any], resp
+) -> Dict[str, Any]:
+    """The one AdmissionReview response envelope, shared by every
+    endpoint (admit / admitlabel / mutate): echoes the request's
+    apiVersion (falling back to admission/v1) and uid, and carries the
+    handler's response dict — including `patchType`/`patch` when the
+    response has one — so the three endpoints can never drift."""
+    return {
+        "apiVersion": review.get("apiVersion", "admission.k8s.io/v1"),
+        "kind": "AdmissionReview",
+        "response": resp.to_dict(uid=request.get("uid")),
+    }
+
+
 def _warm_pod(n_labels: int) -> Dict[str, Any]:
     return {
         "apiVersion": "v1",
@@ -303,6 +318,9 @@ class WebhookServer:
         log_denies: bool = False,
         logger=None,
         tracer=None,
+        # mutation.MutationSystem: wires the /v1/mutate plane (None =
+        # endpoint returns 404, validation-only pod)
+        mutation_system=None,
         # "127.0.0.1" keeps tests hermetic; in-cluster serving must bind
         # the pod IP surface ("0.0.0.0" via run.py) or the apiserver and
         # kubelet probes can never connect
@@ -315,6 +333,25 @@ class WebhookServer:
             namespace_getter=namespace_getter,
             metrics=metrics, tracer=tracer,
         )
+        self.mutate_batcher = None
+        self.mutation_handler = None
+        if mutation_system is not None:
+            # local import: mutate.py imports from this module
+            from .mutate import MutateBatcher, MutationHandler
+
+            self.mutate_batcher = MutateBatcher(
+                mutation_system, window_ms=window_ms,
+                namespace_getter=namespace_getter,
+                metrics=metrics, tracer=tracer,
+            )
+            self.mutation_handler = MutationHandler(
+                self.mutate_batcher,
+                excluder=excluder,
+                metrics=metrics,
+                request_timeout=request_timeout,
+                logger=logger,
+                tracer=tracer,
+            )
         self.handler = BatchedValidationHandler(
             self.batcher, excluder=excluder, metrics=metrics,
             request_timeout=request_timeout,
@@ -328,6 +365,9 @@ class WebhookServer:
         self.label_handler = NamespaceLabelHandler(exempt_namespaces)
         outer = self
 
+        class _Handled(Exception):
+            """Control flow: response already written by the branch."""
+
         class _Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802
                 length = int(self.headers.get("Content-Length", 0))
@@ -337,17 +377,22 @@ class WebhookServer:
                     request = review.get("request") or {}
                     if self.path == "/v1/admitlabel":
                         resp = outer.label_handler.handle(request)
+                    elif self.path == "/v1/mutate":
+                        if outer.mutation_handler is None:
+                            payload = json.dumps(
+                                {"error": "mutation not enabled"}
+                            ).encode()
+                            self.send_response(404)
+                            raise _Handled()
+                        resp = outer.mutation_handler.handle(request)
                     else:
                         resp = outer.handler.handle(request)
-                    out = {
-                        "apiVersion": review.get(
-                            "apiVersion", "admission.k8s.io/v1"
-                        ),
-                        "kind": "AdmissionReview",
-                        "response": resp.to_dict(uid=request.get("uid")),
-                    }
-                    payload = json.dumps(out).encode()
+                    payload = json.dumps(
+                        review_envelope(review, request, resp)
+                    ).encode()
                     self.send_response(200)
+                except _Handled:
+                    pass
                 except Exception as e:
                     payload = json.dumps({"error": str(e)}).encode()
                     self.send_response(500)
@@ -389,6 +434,8 @@ class WebhookServer:
 
     def start(self) -> None:
         self.batcher.start()
+        if self.mutate_batcher is not None:
+            self.mutate_batcher.start()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -448,6 +495,8 @@ class WebhookServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self.batcher.stop()
+        if self.mutate_batcher is not None:
+            self.mutate_batcher.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

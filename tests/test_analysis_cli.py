@@ -126,6 +126,113 @@ def test_no_templates_found(tmp_path):
     assert run([str(tmp_path)]) == 2
 
 
+# -- mutators mode -----------------------------------------------------------
+
+MUTATORS_BASELINE = os.path.join(DEPLOY, "mutators-baseline.json")
+
+BAD_MUTATOR = """apiVersion: mutations.gatekeeper.sh/v1alpha1
+kind: Assign
+metadata:
+  name: broken-path
+spec:
+  applyTo:
+    - groups: [""]
+      versions: ["v1"]
+      kinds: ["Pod"]
+  location: "spec..containers[name *].image"
+  parameters:
+    assign:
+      value: x
+"""
+
+CONFLICTING_PAIR = """apiVersion: mutations.gatekeeper.sh/v1alpha1
+kind: Assign
+metadata:
+  name: obj-view
+spec:
+  applyTo:
+    - groups: [""]
+      versions: ["v1"]
+      kinds: ["Pod"]
+  location: spec.foo.bar
+  parameters:
+    assign:
+      value: x
+---
+apiVersion: mutations.gatekeeper.sh/v1alpha1
+kind: Assign
+metadata:
+  name: list-view
+spec:
+  applyTo:
+    - groups: [""]
+      versions: ["v1"]
+      kinds: ["Pod"]
+  location: "spec.foo[name: x].bar"
+  parameters:
+    assign:
+      value: x
+"""
+
+
+def test_mutators_shipped_examples_hold_the_baseline(capsys):
+    rc = run(["mutators", DEPLOY, "--baseline", MUTATORS_BASELINE])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "OK:" in out
+
+
+def test_mutators_baseline_manifest_is_current():
+    from gatekeeper_tpu.analysis.cli import collect_mutators
+    from gatekeeper_tpu.mutation.lint import lint_mutators
+
+    with open(MUTATORS_BASELINE) as f:
+        recorded = json.load(f)["mutators"]
+    lints = lint_mutators(collect_mutators([DEPLOY]))
+    assert {l.id: sorted(l.codes) for l in lints} == recorded
+
+
+def test_mutators_path_error_reported(tmp_path, capsys):
+    (tmp_path / "bad.yaml").write_text(BAD_MUTATOR)
+    rc = run(["mutators", str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "GK-M001" in captured.out
+
+
+def test_mutators_conflict_reported_json(tmp_path, capsys):
+    (tmp_path / "pair.yaml").write_text(CONFLICTING_PAIR)
+    rc = run(["mutators", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    codes = {m["id"]: m["codes"] for m in payload["mutators"]}
+    assert codes["Assign/obj-view"] == ["GK-M006"]
+    assert codes["Assign/list-view"] == ["GK-M006"]
+
+
+def test_mutators_baseline_pins_regressions(tmp_path, capsys):
+    """A mutator whose baseline was clean must fail when it grows a
+    diagnostic; baselined diagnostics keep passing."""
+    (tmp_path / "pair.yaml").write_text(CONFLICTING_PAIR)
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps(
+        {"mutators": {"Assign/obj-view": [], "Assign/list-view": []}}
+    ))
+    rc = run(["mutators", str(tmp_path), "--baseline", str(clean)])
+    err = capsys.readouterr().err
+    assert rc == 1 and "GK-M006" in err
+
+    pinned = tmp_path / "pinned.json"
+    rc = run(["mutators", str(tmp_path), "--write-baseline", str(pinned)])
+    assert rc == 1  # still flagged without a baseline...
+    rc = run(["mutators", str(tmp_path), "--baseline", str(pinned)])
+    assert rc == 0  # ...but pinned diagnostics pass
+
+
+def test_mutators_none_found(tmp_path):
+    assert run(["mutators", str(tmp_path)]) == 2
+
+
 def test_unsupported_path_rejected(tmp_path):
     p = tmp_path / "notes.txt"
     p.write_text("hi")

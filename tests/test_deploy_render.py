@@ -40,7 +40,8 @@ def test_checked_in_manifest_is_rendered_defaults():
 def test_default_render_shape():
     docs = render.render()
     ks = kinds(docs)
-    assert ks.count("CustomResourceDefinition") == 4
+    # base CRDs + MutatorPodStatus + Assign/AssignMetadata/ModifySet
+    assert ks.count("CustomResourceDefinition") == 8
     for k in (
         "Namespace",
         "ServiceAccount",
@@ -48,6 +49,7 @@ def test_default_render_shape():
         "ClusterRoleBinding",
         "Service",
         "ValidatingWebhookConfiguration",
+        "MutatingWebhookConfiguration",
     ):
         assert ks.count(k) == 1, k
     assert ks.count("Deployment") == 2
@@ -89,6 +91,47 @@ def test_default_render_shape():
         admit["check-ignore-label.gatekeeper.sh"]["failurePolicy"]
         == "Fail"
     )
+    # the mutating config: fail-open, /v1/mutate, and namespace
+    # exclusions IDENTICAL to the validating config's
+    mwh = by_kind(docs, "MutatingWebhookConfiguration")[0]
+    mutate = mwh["webhooks"][0]
+    assert mutate["failurePolicy"] == "Ignore"
+    assert mutate["clientConfig"]["service"]["path"] == "/v1/mutate"
+    assert (
+        mutate["namespaceSelector"]
+        == admit["validation.gatekeeper.sh"]["namespaceSelector"]
+    )
+
+
+def test_mutation_crds_and_disable():
+    docs = render.render()
+    crd_names = {
+        d["metadata"]["name"]
+        for d in by_kind(docs, "CustomResourceDefinition")
+    }
+    for want in (
+        "assign.mutations.gatekeeper.sh",
+        "assignmetadata.mutations.gatekeeper.sh",
+        "modifyset.mutations.gatekeeper.sh",
+        "mutatorpodstatuses.status.gatekeeper.sh",
+    ):
+        assert want in crd_names, crd_names
+    # RBAC covers the mutation group + the MWH object
+    role = by_kind(docs, "ClusterRole")[0]
+    gk_rule = next(
+        r for r in role["rules"]
+        if "mutations.gatekeeper.sh" in r.get("apiGroups", [])
+    )
+    assert "create" in gk_rule["verbs"]
+    adm = next(
+        r for r in role["rules"]
+        if r["apiGroups"] == ["admissionregistration.k8s.io"]
+    )
+    assert "mutatingwebhookconfigurations" in adm["resources"]
+    # disable knob removes only the mutating config
+    off = render.render({"disableMutation": True})
+    assert not by_kind(off, "MutatingWebhookConfiguration")
+    assert by_kind(off, "ValidatingWebhookConfiguration")
 
 
 def test_values_propagate():

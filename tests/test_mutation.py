@@ -1,0 +1,518 @@
+"""Mutation subsystem unit + property battery: the path grammar,
+per-kind mutator semantics, schema-conflict detection, order
+independence, the fixpoint engine (convergence, divergence, the
+never-admit-unconverged contract), RFC 6902 patch round-trips, and
+kernel-vs-oracle screening parity (the mutate-plane counterpart of
+tests/test_fuzz_differential.py's randomized corpora)."""
+
+import random
+
+import pytest
+
+from gatekeeper_tpu.mutation import (
+    ConvergenceError,
+    MutationSystem,
+    MutatorError,
+    PathError,
+    json_patch,
+    mutator_from_obj,
+    parse_path,
+    render_path,
+)
+from gatekeeper_tpu.mutation.patch import apply_patch
+from gatekeeper_tpu.mutation.path import ListNode, ObjectNode
+
+
+def assign(name, location, value, apply_to=None, match=None, params=None):
+    spec = {
+        "applyTo": apply_to
+        or [{"groups": [""], "versions": ["v1"], "kinds": ["Pod"]}],
+        "location": location,
+        "parameters": {"assign": {"value": value}, **(params or {})},
+    }
+    if match is not None:
+        spec["match"] = match
+    return {
+        "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+        "kind": "Assign",
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def assign_meta(name, location, value, match=None):
+    spec = {
+        "location": location,
+        "parameters": {"assign": {"value": value}},
+    }
+    if match is not None:
+        spec["match"] = match
+    return {
+        "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+        "kind": "AssignMetadata",
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def modify_set(name, location, values, operation="merge", match=None):
+    spec = {
+        "applyTo": [{"groups": [""], "versions": ["v1"], "kinds": ["Pod"]}],
+        "location": location,
+        "parameters": {
+            "operation": operation,
+            "values": {"fromList": values},
+        },
+    }
+    if match is not None:
+        spec["match"] = match
+    return {
+        "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+        "kind": "ModifySet",
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def pod(name="p", ns="default", labels=None, containers=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            **({"labels": labels} if labels is not None else {}),
+        },
+        "spec": {
+            "containers": containers
+            or [{"name": "main", "image": "nginx"}],
+        },
+    }
+
+
+def review_for(obj, ns="default"):
+    return {
+        "kind": {"group": "", "version": "v1", "kind": obj.get("kind", "Pod")},
+        "operation": "CREATE",
+        "name": (obj.get("metadata") or {}).get("name", ""),
+        "namespace": ns,
+        "object": obj,
+    }
+
+
+# -- path grammar ------------------------------------------------------------
+
+
+def test_parse_basic_and_roundtrip():
+    p = parse_path("spec.containers[name: *].image")
+    assert p == (
+        ObjectNode("spec"),
+        ListNode("containers", "name", None, True),
+        ObjectNode("image"),
+    )
+    assert parse_path(render_path(p)) == p
+
+
+def test_parse_keyed_and_quoted():
+    p = parse_path('spec.volumes[name: "log dir"].hostPath')
+    assert p[1] == ListNode("volumes", "name", "log dir", False)
+    p2 = parse_path('metadata.labels."my.dotted/key"')
+    assert p2[2] == ObjectNode("my.dotted/key")
+    assert parse_path(render_path(p2)) == p2
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",
+        "  ",
+        "spec..x",
+        "spec.",
+        "spec.containers[name*].x",
+        "spec.containers[: v].x",
+        "spec.containers[name: ].x",
+        'spec."unterminated',
+        "spec.containers[name: *",
+    ],
+)
+def test_parse_errors(bad):
+    with pytest.raises(PathError):
+        parse_path(bad)
+
+
+# -- mutator semantics -------------------------------------------------------
+
+
+def test_assign_glob_sets_every_element():
+    m = mutator_from_obj(
+        assign("a", "spec.containers[name: *].imagePullPolicy", "Always")
+    )
+    p = pod(containers=[{"name": "a", "image": "x"},
+                        {"name": "b", "image": "y"}])
+    out, changed = m.apply(p, review_for(p))
+    assert changed
+    assert [c["imagePullPolicy"] for c in out["spec"]["containers"]] == [
+        "Always", "Always",
+    ]
+    # input untouched
+    assert "imagePullPolicy" not in p["spec"]["containers"][0]
+    # idempotent
+    out2, changed2 = m.apply(out, review_for(out))
+    assert not changed2 and out2 == out
+
+
+def test_assign_keyed_creates_missing_element():
+    m = mutator_from_obj(
+        assign("a", "spec.containers[name: sidecar].image", "envoy")
+    )
+    p = pod()
+    out, changed = m.apply(p, review_for(p))
+    assert changed
+    assert {"name": "sidecar", "image": "envoy"} in out["spec"]["containers"]
+
+
+def test_assign_creates_intermediate_objects():
+    m = mutator_from_obj(assign("a", "spec.securityContext.runAsUser", 1000))
+    p = pod()
+    out, changed = m.apply(p, review_for(p))
+    assert changed
+    assert out["spec"]["securityContext"]["runAsUser"] == 1000
+
+
+def test_assign_rejects_metadata_location():
+    with pytest.raises(MutatorError):
+        mutator_from_obj(assign("a", "metadata.labels.x", "v"))
+
+
+def test_assign_if_and_path_tests():
+    m = mutator_from_obj(
+        assign(
+            "a",
+            "spec.containers[name: *].imagePullPolicy",
+            "Always",
+            params={"assignIf": {"in": [None, "IfNotPresent"]}},
+        )
+    )
+    p = pod(containers=[
+        {"name": "a", "image": "x"},                              # absent
+        {"name": "b", "image": "y", "imagePullPolicy": "IfNotPresent"},
+        {"name": "c", "image": "z", "imagePullPolicy": "Never"},  # kept
+    ])
+    out, _ = m.apply(p, review_for(p))
+    got = [c.get("imagePullPolicy") for c in out["spec"]["containers"]]
+    assert got == ["Always", "Always", "Never"]
+
+    guard = mutator_from_obj(
+        assign(
+            "g", "spec.priorityClassName", "high",
+            params={"pathTests": [
+                {"subPath": "spec.priorityClassName",
+                 "condition": "MustNotExist"},
+            ]},
+        )
+    )
+    p2 = pod()
+    p2["spec"]["priorityClassName"] = "low"
+    out2, changed2 = guard.apply(p2, review_for(p2))
+    assert not changed2 and out2["spec"]["priorityClassName"] == "low"
+
+
+def test_assign_type_mismatch_raises():
+    from gatekeeper_tpu.mutation import MutationApplyError
+
+    m = mutator_from_obj(assign("a", "spec.containers.image", "x"))
+    p = pod()  # containers is a LIST, path says object
+    with pytest.raises(MutationApplyError):
+        m.apply(p, review_for(p))
+
+
+def test_assignmetadata_never_overwrites():
+    m = mutator_from_obj(assign_meta("t", "metadata.labels.owner", "plat"))
+    p = pod(labels={"owner": "alice"})
+    out, changed = m.apply(p, review_for(p))
+    assert not changed and out["metadata"]["labels"]["owner"] == "alice"
+    p2 = pod()  # no labels map at all: created
+    out2, changed2 = m.apply(p2, review_for(p2))
+    assert changed2 and out2["metadata"]["labels"]["owner"] == "plat"
+
+
+def test_assignmetadata_location_validation():
+    with pytest.raises(MutatorError):
+        mutator_from_obj(assign_meta("t", "spec.labels.x", "v"))
+    with pytest.raises(MutatorError):
+        mutator_from_obj(assign_meta("t", "metadata.name", "v"))
+
+
+def test_modifyset_merge_and_prune():
+    merge = mutator_from_obj(
+        modify_set("m", "spec.containers[name: main].args",
+                   ["--a", "--b"])
+    )
+    p = pod(containers=[{"name": "main", "image": "x", "args": ["--b"]}])
+    out, changed = merge.apply(p, review_for(p))
+    assert changed
+    assert out["spec"]["containers"][0]["args"] == ["--b", "--a"]
+    out2, changed2 = merge.apply(out, review_for(out))
+    assert not changed2
+
+    prune = mutator_from_obj(
+        modify_set("pr", "spec.containers[name: main].args",
+                   ["--b"], operation="prune")
+    )
+    out3, changed3 = prune.apply(out, review_for(out))
+    assert changed3
+    assert out3["spec"]["containers"][0]["args"] == ["--a"]
+    # prune never creates the list
+    p4 = pod()
+    out4, changed4 = prune.apply(p4, review_for(p4))
+    assert not changed4 and "args" not in out4["spec"]["containers"][0]
+
+
+# -- system: conflicts, ordering, fixpoint -----------------------------------
+
+
+def test_schema_conflict_quarantines_both():
+    sys_ = MutationSystem()
+    sys_.upsert(assign("obj-view", "spec.foo.bar", "v"))
+    assert not sys_.conflicts()
+    sys_.upsert(assign("list-view", "spec.foo[name: x].bar", "v"))
+    conf = sys_.conflicts()
+    assert set(conf) == {"Assign/obj-view", "Assign/list-view"}
+    # both quarantined: nothing applies
+    assert sys_.ordered() == []
+    # clearing one side clears the conflict
+    sys_.remove("Assign/list-view")
+    assert not sys_.conflicts()
+    assert [m.id for m in sys_.ordered()] == ["Assign/obj-view"]
+
+
+def test_list_key_field_disagreement_conflicts():
+    sys_ = MutationSystem()
+    sys_.upsert(assign("by-name", "spec.items[name: a].v", 1))
+    sys_.upsert(assign("by-key", "spec.items[key: a].v", 1))
+    assert len(sys_.conflicts()) == 2
+
+
+def test_terminal_node_does_not_conflict():
+    sys_ = MutationSystem()
+    # one terminates at spec.foo (type unknown), the other traverses
+    # spec.foo as an object — compatible
+    sys_.upsert(assign("term", "spec.foo", "v"))
+    sys_.upsert(assign("deep", "spec.foo.bar", "v"))
+    assert not sys_.conflicts()
+
+
+def test_ingestion_order_independence():
+    docs = [
+        assign_meta("z-last", "metadata.labels.z", "1"),
+        assign("a-first", "spec.containers[name: *].imagePullPolicy",
+               "Always"),
+        modify_set("m-mid", "spec.containers[name: main].args", ["--x"]),
+    ]
+    p = pod()
+    rev = review_for(p)
+    results = []
+    for order in (docs, docs[::-1], [docs[1], docs[0], docs[2]]):
+        sys_ = MutationSystem()
+        for d in order:
+            sys_.upsert(d)
+        out, _ = sys_.apply(p, rev)
+        results.append(out)
+    assert results[0] == results[1] == results[2]
+
+
+def test_fixpoint_chains_converge():
+    # A's pathTest is satisfied only after B runs (B sorts after A), so
+    # convergence needs a second pass
+    sys_ = MutationSystem()
+    sys_.upsert(assign(
+        "a-needs-b", "spec.priorityClassName", "high",
+        params={"pathTests": [
+            {"subPath": "spec.schedulerName", "condition": "MustExist"},
+        ]},
+    ))
+    sys_.upsert(assign("b-sets", "spec.schedulerName", "custom"))
+    p = pod()
+    out, iters = sys_.apply(p, review_for(p))
+    assert out["spec"]["priorityClassName"] == "high"
+    assert iters >= 2
+
+
+def test_divergence_raises_never_admits():
+    sys_ = MutationSystem()
+    # two mutators that flip the same field forever
+    sys_.upsert(assign(
+        "flip-a", "spec.phase", "a",
+        params={"assignIf": {"in": [None, "b"]}},
+    ))
+    sys_.upsert(assign(
+        "flip-b", "spec.phase", "b",
+        params={"assignIf": {"in": [None, "a"]}},
+    ))
+    p = pod()
+    with pytest.raises(ConvergenceError):
+        sys_.apply(p, review_for(p))
+
+
+# -- screening: kernel vs oracle parity --------------------------------------
+
+
+def rand_match(rng):
+    match = {}
+    r = rng.random()
+    if r < 0.3:
+        match["kinds"] = [{"apiGroups": [""], "kinds": ["Pod"]}]
+    elif r < 0.4:
+        match["kinds"] = [{"apiGroups": ["*"], "kinds": ["*"]}]
+    if rng.random() < 0.4:
+        match["namespaces"] = rng.sample(
+            ["default", "prod", "dev", "kube-system"], rng.randrange(1, 3)
+        )
+    if rng.random() < 0.3:
+        match["excludedNamespaces"] = [rng.choice(["prod", "dev"])]
+    if rng.random() < 0.3:
+        match["scope"] = rng.choice(["*", "Namespaced", "Cluster"])
+    if rng.random() < 0.4:
+        match["labelSelector"] = {
+            "matchLabels": {rng.choice(["app", "env"]): rng.choice(
+                ["web", "worker", "prod"]
+            )}
+        }
+    if rng.random() < 0.25:
+        match["namespaceSelector"] = {
+            "matchExpressions": [{
+                "key": "env",
+                "operator": rng.choice(["In", "Exists", "DoesNotExist"]),
+                "values": ["prod"],
+            }]
+        }
+    return match
+
+
+@pytest.mark.parametrize("seed", [11, 5309])
+def test_screen_kernel_matches_oracle(seed):
+    rng = random.Random(seed)
+    sys_ = MutationSystem()
+    for i in range(12):
+        kind = i % 3
+        if kind == 0:
+            sys_.upsert(assign_meta(
+                f"am{i}", f"metadata.labels.k{i}", "v",
+                match=rand_match(rng),
+            ))
+        elif kind == 1:
+            sys_.upsert(assign(
+                f"as{i}", f"spec.f{i}", i, match=rand_match(rng),
+            ))
+        else:
+            sys_.upsert(modify_set(
+                f"ms{i}", "spec.containers[name: main].args",
+                [f"--{i}"], match=rand_match(rng),
+            ))
+    reviews = []
+    for i in range(24):
+        labels = (
+            {rng.choice(["app", "env"]): rng.choice(["web", "worker"])}
+            if rng.random() < 0.7 else None
+        )
+        ns = rng.choice(["default", "prod", "dev", ""])
+        obj = pod(f"p{i}", ns=ns or "default", labels=labels)
+        rev = review_for(obj, ns=ns or "default")
+        if not ns:
+            rev.pop("namespace")
+        if rng.random() < 0.3:
+            rev["_unstable"] = {
+                "namespace": {
+                    "metadata": {"name": ns, "labels": {"env": "prod"}}
+                }
+            }
+        reviews.append(rev)
+    muts_k, mat_k = sys_.screen(reviews)
+    muts_h, mat_h = sys_.screen_host(reviews)
+    assert [m.id for m in muts_k] == [m.id for m in muts_h]
+    assert (mat_k == mat_h).all(), (
+        f"seed={seed}: kernel/oracle divergence at "
+        f"{list(zip(*((mat_k != mat_h).nonzero())))}"
+    )
+    assert sys_.screen_dispatches >= 1
+
+
+# -- patches -----------------------------------------------------------------
+
+
+def test_json_patch_round_trip_shapes():
+    cases = [
+        ({"a": 1}, {"a": 2}),
+        ({"a": 1}, {"a": 1, "b": {"c": [1, 2]}}),
+        ({"a": {"b": 1}, "z": 0}, {"a": {}}),
+        ({"l": [1, 2]}, {"l": [1, 2, 3, 4]}),
+        ({"l": [1, 2, 3]}, {"l": [1]}),
+        ({"l": [{"x": 1}, {"y": 2}]}, {"l": [{"x": 9}, {"y": 2}]}),
+        ({"l": [1, 2]}, {"l": [2, 1, 0]}),
+        ({"k~ey": {"a/b": 1}}, {"k~ey": {"a/b": 2}}),
+    ]
+    for before, after in cases:
+        ops = json_patch(before, after)
+        assert apply_patch(before, ops) == after, (before, after, ops)
+    assert json_patch({"a": 1}, {"a": 1}) == []
+
+
+@pytest.mark.parametrize("seed", [7, 99])
+def test_property_apply_twice_equals_once(seed):
+    """Idempotence/convergence property: for randomized mutator sets
+    and pod corpora, mutate(mutate(x)) == mutate(x), and the rendered
+    patch replays the mutation exactly."""
+    rng = random.Random(seed)
+    sys_ = MutationSystem()
+    for i in range(9):
+        kind = rng.randrange(3)
+        if kind == 0:
+            sys_.upsert(assign_meta(
+                f"am{i}",
+                f"metadata.labels.auto-{rng.randrange(4)}",
+                f"v{rng.randrange(3)}",
+                match=rand_match(rng),
+            ))
+        elif kind == 1:
+            sys_.upsert(assign(
+                f"as{i}",
+                rng.choice([
+                    "spec.containers[name: *].imagePullPolicy",
+                    f"spec.extra-{rng.randrange(3)}",
+                    "spec.containers[name: sidecar].image",
+                ]),
+                rng.choice(["Always", 5, {"nested": True}]),
+                match=rand_match(rng),
+            ))
+        else:
+            sys_.upsert(modify_set(
+                f"ms{i}",
+                "spec.containers[name: *].args",
+                [f"--f{rng.randrange(5)}" for _ in range(2)],
+                operation=rng.choice(["merge", "prune"]),
+                match=rand_match(rng),
+            ))
+    assert not sys_.conflicts(), sys_.conflicts()
+    for i in range(20):
+        containers = [
+            {"name": rng.choice(["main", "sidecar", f"c{j}"]),
+             "image": "nginx",
+             **({"args": [f"--f{rng.randrange(5)}"]}
+                if rng.random() < 0.5 else {})}
+            for j in range(rng.randrange(1, 3))
+        ]
+        labels = (
+            {f"auto-{rng.randrange(4)}": "preset"}
+            if rng.random() < 0.4 else None
+        )
+        obj = pod(f"p{i}", ns=rng.choice(["default", "prod", "dev"]),
+                  labels=labels, containers=containers)
+        rev = review_for(obj, ns=obj["metadata"]["namespace"])
+        muts, mat = sys_.screen_host([rev])
+        selected = [m for j, m in enumerate(muts) if mat[j, 0]]
+        once, _ = sys_.apply(obj, rev, selected)
+        twice, iters2 = sys_.apply(once, rev, selected)
+        assert twice == once, f"seed={seed} obj#{i} not idempotent"
+        assert iters2 == 1  # already at the fixpoint
+        ops = json_patch(obj, once)
+        assert apply_patch(obj, ops) == once, f"seed={seed} obj#{i}"

@@ -1,0 +1,453 @@
+"""Mutation webhook + control plane e2e: the `/v1/mutate` endpoint
+(micro-batched with ONE kernel screen dispatch per batch, RFC 6902
+responses, divergence rejection), the shared response envelope, the
+MutatorController ingestion path, and the Config wipe/replay motion."""
+
+import base64
+import json
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget, RegoDriver
+from gatekeeper_tpu.control import Excluder
+from gatekeeper_tpu.metrics import MetricsRegistry
+from gatekeeper_tpu.mutation import MutationSystem
+from gatekeeper_tpu.mutation.patch import apply_patch
+from gatekeeper_tpu.webhook import MutateBatcher, MutationHandler, WebhookServer
+from gatekeeper_tpu.webhook.policy import SERVICE_ACCOUNT
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+
+def assign_meta(name, key, value):
+    return {
+        "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+        "kind": "AssignMetadata",
+        "metadata": {"name": name},
+        "spec": {
+            "location": f"metadata.labels.{key}",
+            "parameters": {"assign": {"value": value}},
+        },
+    }
+
+
+def assign(name, location, value, params=None, match=None):
+    spec = {
+        "applyTo": [{"groups": [""], "versions": ["v1"], "kinds": ["Pod"]}],
+        "location": location,
+        "parameters": {"assign": {"value": value}, **(params or {})},
+    }
+    if match is not None:
+        spec["match"] = match
+    return {
+        "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+        "kind": "Assign",
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def admission_request(i=0, ns="default", operation="CREATE"):
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": f"p{i}", "namespace": ns},
+        "spec": {"containers": [{"name": "main", "image": "nginx"}]},
+    }
+    return {
+        "uid": f"uid{i}",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": operation,
+        "name": f"p{i}",
+        "namespace": ns,
+        "userInfo": {"username": "alice"},
+        "object": obj,
+    }
+
+
+def make_system(metrics=None):
+    system = MutationSystem(metrics=metrics)
+    system.upsert(assign_meta("tag-owner", "owner", "platform"))
+    system.upsert(
+        assign(
+            "pull-policy",
+            "spec.containers[name: *].imagePullPolicy",
+            "Always",
+        )
+    )
+    return system
+
+
+# -- batcher: one screen dispatch per micro-batch ----------------------------
+
+
+def test_micro_batch_records_one_screen_dispatch():
+    """The acceptance contract: N concurrent mutate requests coalesce
+    into ONE match-kernel screen dispatch, visible in metrics."""
+    metrics = MetricsRegistry()
+    system = make_system(metrics)
+    # long window so every submit lands in the same batch
+    batcher = MutateBatcher(system, window_ms=250, metrics=metrics)
+    batcher.start()
+    try:
+        n = 12
+        futs = [batcher.submit(admission_request(i)) for i in range(n)]
+        patches = [f.result(timeout=60) for f in futs]
+    finally:
+        batcher.stop()
+    for patch in patches:
+        paths = {op["path"] for op in patch}
+        assert "/metadata/labels" in paths or (
+            "/metadata/labels/owner" in paths
+        ), patch
+        assert "/spec/containers/0/imagePullPolicy" in paths
+    assert batcher.batches_dispatched == 1
+    assert system.screen_dispatches == 1
+    snap = metrics.snapshot()
+    assert snap["counters"]["mutation_screen_dispatch_total"] == 1
+    assert snap["counters"]["mutation_batches_total"] == 1
+    dist = snap["distributions"]["mutation_screen_batch_size"]
+    assert dist["count"] == 1 and dist["max"] == n
+
+
+def test_divergent_pair_rejected_never_admitted():
+    """A non-converging mutator pair produces a divergence error — the
+    handler answers 500 / not allowed, never a partial patch."""
+    metrics = MetricsRegistry()
+    system = MutationSystem(metrics=metrics)
+    system.upsert(assign(
+        "flip-a", "spec.phase", "a",
+        params={"assignIf": {"in": [None, "b"]}},
+    ))
+    system.upsert(assign(
+        "flip-b", "spec.phase", "b",
+        params={"assignIf": {"in": [None, "a"]}},
+    ))
+    batcher = MutateBatcher(system, window_ms=1.0, metrics=metrics)
+    handler = MutationHandler(batcher, metrics=metrics, request_timeout=60)
+    batcher.start()
+    try:
+        resp = handler.handle(admission_request(0))
+    finally:
+        batcher.stop()
+    assert not resp.allowed and resp.code == 500
+    assert "converge" in resp.message
+    assert resp.patch is None
+    snap = metrics.snapshot()
+    assert snap["counters"]["mutation_divergence_total"] >= 1
+    assert (
+        snap["counters"]['mutation_request_count{mutation_status="error"}']
+        == 1
+    )
+
+
+def test_handler_bypasses():
+    system = make_system()
+    batcher = MutateBatcher(system, window_ms=1.0)
+    excluder = Excluder()
+    excluder.add([
+        {"processes": ["webhook"], "excludedNamespaces": ["kube-system"]}
+    ])
+    handler = MutationHandler(
+        batcher, excluder=excluder, request_timeout=60
+    )
+    batcher.start()
+    try:
+        # gatekeeper's own SA
+        req = admission_request(1)
+        req["userInfo"] = {"username": SERVICE_ACCOUNT}
+        resp = handler.handle(req)
+        assert resp.allowed and resp.patch is None
+        # excluded namespace
+        resp = handler.handle(admission_request(2, ns="kube-system"))
+        assert resp.allowed and resp.patch is None
+        assert "ignored" in resp.message
+        # DELETE never mutates
+        resp = handler.handle(admission_request(3, operation="DELETE"))
+        assert resp.allowed and resp.patch is None
+        # plain CREATE mutates
+        resp = handler.handle(admission_request(4))
+        assert resp.allowed and resp.patch
+    finally:
+        batcher.stop()
+
+
+def test_screen_respects_match_and_applyto():
+    system = MutationSystem()
+    system.upsert(assign(
+        "prod-only", "spec.priority", 1,
+        match={"namespaces": ["prod"]},
+    ))
+    batcher = MutateBatcher(system, window_ms=1.0)
+    handler = MutationHandler(batcher, request_timeout=60)
+    batcher.start()
+    try:
+        hit = handler.handle(admission_request(0, ns="prod"))
+        miss = handler.handle(admission_request(1, ns="dev"))
+    finally:
+        batcher.stop()
+    assert hit.patch and not miss.patch
+
+
+def test_device_screen_failure_falls_back_to_oracle(monkeypatch):
+    """A faulted device screen degrades to the host oracle — requests
+    still get correct patches (fail-soft screening)."""
+    metrics = MetricsRegistry()
+    system = make_system(metrics)
+
+    def boom(reviews, ns_cache=None):
+        raise RuntimeError("device fault injected")
+
+    monkeypatch.setattr(system, "screen", boom)
+    batcher = MutateBatcher(system, window_ms=1.0, metrics=metrics)
+    handler = MutationHandler(batcher, metrics=metrics, request_timeout=60)
+    batcher.start()
+    try:
+        resp = handler.handle(admission_request(0))
+    finally:
+        batcher.stop()
+    assert resp.allowed and resp.patch
+    assert (
+        metrics.snapshot()["counters"]["mutation_batch_failures_total"] == 1
+    )
+
+
+# -- HTTP e2e ----------------------------------------------------------------
+
+
+@pytest.fixture()
+def client():
+    return Backend(RegoDriver()).new_client(K8sValidationTarget())
+
+
+def _post(port, path, req, api_version="admission.k8s.io/v1"):
+    body = {"kind": "AdmissionReview", "request": req}
+    if api_version is not None:
+        body["apiVersion"] = api_version
+    r = urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        ),
+        timeout=60,
+    )
+    return json.loads(r.read())
+
+
+def test_mutate_endpoint_end_to_end(client):
+    """Concurrent AdmissionReviews through HTTP: valid RFC 6902 patches
+    that replay onto the object, uid/apiVersion echo via the shared
+    envelope, and the whole run costs a handful of screen dispatches
+    (micro-batching), not one per request."""
+    metrics = MetricsRegistry()
+    system = make_system(metrics)
+    server = WebhookServer(
+        client, TARGET, window_ms=25.0, metrics=metrics,
+        mutation_system=system, request_timeout=60,
+    )
+    server.start()
+    try:
+        n = 16
+        with ThreadPoolExecutor(max_workers=n) as ex:
+            outs = list(ex.map(
+                lambda i: _post(
+                    server.port, "/v1/mutate", admission_request(i)
+                ),
+                range(n),
+            ))
+        for i, out in enumerate(outs):
+            assert out["apiVersion"] == "admission.k8s.io/v1"
+            resp = out["response"]
+            assert resp["uid"] == f"uid{i}"
+            assert resp["allowed"] is True
+            assert resp["patchType"] == "JSONPatch"
+            ops = json.loads(base64.b64decode(resp["patch"]))
+            assert isinstance(ops, list) and ops
+            for op in ops:
+                assert op["op"] in ("add", "replace", "remove")
+                assert op["path"].startswith("/")
+            mutated = apply_patch(
+                admission_request(i)["object"], ops
+            )
+            assert mutated["metadata"]["labels"]["owner"] == "platform"
+            assert (
+                mutated["spec"]["containers"][0]["imagePullPolicy"]
+                == "Always"
+            )
+        # micro-batching: far fewer screens than requests
+        assert 1 <= system.screen_dispatches < n
+        # the validating plane still works on the same server
+        out = _post(server.port, "/v1/admit", admission_request(0))
+        assert out["response"]["allowed"] is True
+    finally:
+        server.stop()
+
+
+def test_envelope_shared_across_endpoints(client):
+    """The factored envelope: apiVersion fallback + uid echo behave
+    identically on /v1/admit, /v1/admitlabel, and /v1/mutate."""
+    system = make_system()
+    server = WebhookServer(
+        client, TARGET, window_ms=1.0, mutation_system=system,
+        request_timeout=60,
+    )
+    server.start()
+    try:
+        for path in ("/v1/admit", "/v1/mutate", "/v1/admitlabel"):
+            req = admission_request(7)
+            if path == "/v1/admitlabel":
+                req["object"] = {
+                    "apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "ok"},
+                }
+                req["kind"] = {
+                    "group": "", "version": "v1", "kind": "Namespace"
+                }
+            # absent apiVersion falls back identically everywhere
+            out = _post(server.port, path, req, api_version=None)
+            assert out["apiVersion"] == "admission.k8s.io/v1", path
+            assert out["kind"] == "AdmissionReview"
+            assert out["response"]["uid"] == "uid7", path
+            # explicit apiVersion echoes identically everywhere
+            out = _post(
+                server.port, path, req,
+                api_version="admission.k8s.io/v1beta1",
+            )
+            assert out["apiVersion"] == "admission.k8s.io/v1beta1", path
+    finally:
+        server.stop()
+
+
+def test_mutate_endpoint_404_without_system(client):
+    server = WebhookServer(client, TARGET, window_ms=1.0)
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _post(server.port, "/v1/mutate", admission_request(0))
+        assert exc_info.value.code == 404
+    finally:
+        server.stop()
+
+
+# -- control plane -----------------------------------------------------------
+
+
+def test_mutator_controller_ingest_conflict_and_status():
+    from gatekeeper_tpu.control.controllers import (
+        MUTATOR_GVKS,
+        MutatorController,
+    )
+    from gatekeeper_tpu.control.events import ADDED, DELETED, Event
+    from gatekeeper_tpu.control import FakeCluster
+    from gatekeeper_tpu.control.status import MUTATOR_STATUS_GVK, StatusWriter
+
+    cluster = FakeCluster()
+    metrics = MetricsRegistry()
+    system = MutationSystem(metrics=metrics)
+    ctrl = MutatorController(
+        system,
+        metrics=metrics,
+        status=StatusWriter(cluster, "pod-1"),
+    )
+    gvk_assign = MUTATOR_GVKS[0]
+
+    ok = assign("obj-view", "spec.foo.bar", "v")
+    ctrl.sink(Event(ADDED, gvk_assign, ok))
+    assert system.count() == 1 and not ctrl.errors
+
+    bad = assign("broken", "spec..x", "v")
+    ctrl.sink(Event(ADDED, gvk_assign, bad))
+    assert "Assign/broken" in ctrl.errors
+    statuses = cluster.list(MUTATOR_STATUS_GVK)
+    by_name = {s["metadata"]["name"]: s for s in statuses}
+    broken = by_name["pod-1-assign-broken"]
+    assert not broken["status"]["enforced"]
+    assert broken["status"]["errors"][0]["code"] == "ingest_error"
+
+    # a conflicting pair publishes schema_conflict status on the NEW one
+    conflicting = assign("list-view", "spec.foo[name: x].bar", "v")
+    ctrl.sink(Event(ADDED, gvk_assign, conflicting))
+    statuses = {
+        s["metadata"]["name"]: s for s in cluster.list(MUTATOR_STATUS_GVK)
+    }
+    conf = statuses["pod-1-assign-list-view"]
+    assert conf["status"]["errors"][0]["code"] == "schema_conflict"
+    assert system.ordered() == []  # both quarantined
+
+    snap = metrics.snapshot()
+    assert snap["gauges"]["mutator_conflicts"] == 2
+    assert (
+        snap["gauges"]['mutators{kind="Assign",status="conflict"}'] == 2
+    )
+    # two error ingests: the broken spec AND the conflict-introducing
+    # upsert (a conflicted mutator ingests as error)
+    assert (
+        snap["counters"]['mutator_ingestion_count{status="error"}'] == 2
+    )
+
+    # deletion clears the conflict and the status CR
+    ctrl.sink(Event(DELETED, gvk_assign, conflicting))
+    assert [m.id for m in system.ordered()] == ["Assign/obj-view"]
+    names = {
+        s["metadata"]["name"] for s in cluster.list(MUTATOR_STATUS_GVK)
+    }
+    assert "pod-1-assign-list-view" not in names
+
+
+def test_runner_wires_mutation_and_config_replays():
+    """Full-runner integration: mutator CRs ingest through the watch
+    plane into the served /v1/mutate endpoint, and a Config change
+    wipes + replays the mutator set (the sync plane's replayData
+    motion)."""
+    from gatekeeper_tpu.control import FakeCluster
+    from gatekeeper_tpu.control.runner import Runner
+
+    cluster = FakeCluster()
+    cluster.apply(assign_meta("tag-owner", "owner", "platform"))
+    client = Backend(RegoDriver()).new_client(K8sValidationTarget())
+    runner = Runner(
+        cluster, client, TARGET,
+        operations=("webhook", "status"),
+        webhook_tls=False,
+        readyz_port=None,
+    )
+    runner.start()
+    try:
+        assert runner.wait_ready(30)
+        deadline = threading.Event()
+        for _ in range(200):
+            if runner.mutation_system.count() == 1:
+                break
+            deadline.wait(0.05)
+        assert runner.mutation_system.count() == 1
+        out = _post(
+            runner.webhook.port, "/v1/mutate", admission_request(0)
+        )
+        ops = json.loads(base64.b64decode(out["response"]["patch"]))
+        assert any(
+            op["path"].endswith("/owner") or op["path"].endswith("labels")
+            for op in ops
+        )
+        # Config change → wipe + replay: the set survives (re-listed)
+        cluster.apply({
+            "apiVersion": "config.gatekeeper.sh/v1alpha1",
+            "kind": "Config",
+            "metadata": {
+                "name": "config", "namespace": "gatekeeper-system"
+            },
+            "spec": {"match": []},
+        })
+        runner.watch_mgr.wait_idle(timeout=5)
+        for _ in range(200):
+            if runner.mutation_system.count() == 1:
+                break
+            deadline.wait(0.05)
+        assert runner.mutation_system.count() == 1
+        # generation bumped: the set was rebuilt, not left stale
+        assert runner.mutation_system.generation >= 2
+    finally:
+        runner.stop()
