@@ -401,6 +401,121 @@ def run_mutate_bench(n_requests=10_000, n_mutators=30, err=sys.stderr):
     }
 
 
+_CHAOS_REGO = """package chaosbench
+
+violation[{"msg": msg}] {
+    input.review.object.spec.containers[_].securityContext.privileged
+    msg := "privileged container"
+}
+"""
+
+
+def build_chaos_client(driver, n_constraints):
+    """Self-contained policy load (no reference-library dependency):
+    the chaos bench measures the failure ENVELOPE — shed rate, breaker
+    behavior, degraded-mode latency — not the policy mix, so one
+    inline template with n constraint instances is the right corpus
+    and keeps --chaos runnable on any machine."""
+    from gatekeeper_tpu.constraint import Backend, K8sValidationTarget
+
+    client = Backend(driver).new_client(K8sValidationTarget())
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "chaosbench"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "ChaosBench"}}},
+            "targets": [{"target": TARGET, "rego": _CHAOS_REGO}],
+        },
+    })
+    for i in range(n_constraints):
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "ChaosBench",
+            "metadata": {"name": f"cb{i}"},
+            "spec": {"match": {"kinds": [
+                {"apiGroups": [""], "kinds": ["Pod"]}
+            ]}},
+        })
+    return client
+
+
+def run_chaos_bench(n_requests=3000, n_constraints=20, err=sys.stderr):
+    """The `--chaos` replay (docs/robustness.md): drive the admission
+    plane through three phases — clean, device-faulted, recovered — and
+    report p50/p99, shed rate, degraded dispatches, and circuit-breaker
+    transitions per phase. The faulted phase arms the REAL
+    `webhook.batch_dispatch` fault point, so the measured p99 is the
+    host-oracle degraded mode the breaker buys (vs paying a doomed
+    fused attempt per batch)."""
+    from gatekeeper_tpu.constraint import TpuDriver
+    from gatekeeper_tpu.faults import FAULTS, CircuitBreaker
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    metrics = MetricsRegistry()
+    client = build_chaos_client(TpuDriver(), n_constraints)
+    breaker = CircuitBreaker(
+        failure_threshold=3, recovery_seconds=1.0, metrics=metrics
+    )
+    batcher = MicroBatcher(
+        client, TARGET, window_ms=2.0, metrics=metrics,
+        max_queue=512, breaker=breaker,
+    )
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=10, metrics=metrics, fail_policy="open"
+    )
+    n_sub = max(400, n_requests // 6)
+    out = []
+    batcher.start()
+    try:
+        _warm_route(client)
+        replay(handler, [make_request(i) for i in range(512)], 128)
+
+        def run_phase(name):
+            shed0 = batcher.shed_count
+            fail0 = batcher.batch_failures
+            trans0 = breaker.transitions
+            snap0 = metrics.snapshot()["counters"]
+            deg_key = 'webhook_degraded_dispatch_total{plane="validation"}'
+            deg0 = snap0.get(deg_key, 0)
+            r = replay(
+                handler, [make_request(i) for i in range(n_sub)], 128
+            )
+            snap1 = metrics.snapshot()["counters"]
+            r.update(
+                phase=name,
+                shed=batcher.shed_count - shed0,
+                shed_rate=round((batcher.shed_count - shed0) / n_sub, 4),
+                batch_failures=batcher.batch_failures - fail0,
+                degraded_dispatches=snap1.get(deg_key, 0) - deg0,
+                breaker_transitions=breaker.transitions - trans0,
+                breaker_state=breaker.state,
+            )
+            out.append(r)
+            print(f"chaos phase: {r}", file=err)
+
+        run_phase("clean")
+        FAULTS.arm("webhook.batch_dispatch", mode="error")
+        run_phase("device_fault")
+        FAULTS.reset()
+        time.sleep(1.2)  # recovery window: next batch is the probe
+        run_phase("recovered")
+    finally:
+        batcher.stop()
+        FAULTS.reset()
+    return {
+        "constraints": n_constraints,
+        "fail_policy": "open",
+        "max_queue": batcher.max_queue,
+        "breaker": breaker.snapshot(),
+        "phases": out,
+    }
+
+
 # the reference harness's constraint-count ladder
 # (pkg/webhook/policy_benchmark_test.go:265-276)
 LADDER = (5, 10, 50, 100, 200, 1000, 2000)
@@ -692,6 +807,11 @@ if __name__ == "__main__":
     if "--ladder" in sys.argv:
         rows, skipped = run_constraint_ladder()
         print(json.dumps({"rungs": rows, "skipped": skipped}))
+    elif "--chaos" in sys.argv:
+        pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n_req = int(pos[0]) if pos else 3_000
+        n_con = int(pos[1]) if len(pos) > 1 else 20
+        print(json.dumps(run_chaos_bench(n_req, n_con)))
     elif "--mutate" in sys.argv:
         pos = [a for a in sys.argv[1:] if not a.startswith("--")]
         n_req = int(pos[0]) if pos else 10_000

@@ -29,6 +29,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ..faults import fire
+
 DEFAULT_AUDIT_INTERVAL = 60.0
 DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT = 20
 DEFAULT_MSG_SIZE = 256
@@ -305,7 +307,25 @@ class AuditManager:
                 constraint_violations=str(st.total_violations),
             )
         t_pub0 = time.time()
-        self.sink.publish(report)
+        try:
+            # named fault point (docs/robustness.md): a K8s status-write
+            # error — the reference's retry-with-backoff surface
+            fire("audit.status_write")
+            self.sink.publish(report)
+        except Exception as e:
+            # a failed status write must not void the sweep: the report
+            # is still returned (and the next sweep re-publishes the
+            # full state — statuses are absolute, not deltas)
+            if self.metrics is not None:
+                self.metrics.record("audit_status_write_failures_total", 1)
+            log.error(
+                "constraint status publish failed; next sweep will "
+                "re-publish",
+                err=e,
+                trace_id=getattr(root, "trace_id", None),
+            )
+            if root is not None:
+                root.set_attr(status_write_error=str(e))
         t_pub1 = time.time()
         if self.tracer is not None:
             # aggregate/status_write stamped from timing marks instead
@@ -479,9 +499,29 @@ class AuditManager:
     def _loop(self) -> None:
         if self.wait_for is not None:
             try:
+                fire("audit.barrier")  # chaos: simulate a barrier fault
                 self.wait_for(300.0)
-            except Exception:
-                pass  # barrier failure: sweep anyway (fail-open posture)
+            except Exception as e:
+                # barrier failure: sweep anyway (fail-open posture) —
+                # but NEVER silently. The first sweep running against a
+                # partially ingested cache under-reports violations; an
+                # operator must be able to see that happened (counter)
+                # and find the why (trace + correlated log record).
+                trace_id = None
+                if self.tracer is not None:
+                    with self.tracer.start_span(
+                        "audit_barrier_failure", error=str(e)
+                    ) as sp:
+                        trace_id = sp.trace_id
+                if self.metrics is not None:
+                    self.metrics.record("audit_barrier_failures_total", 1)
+                self.log.error(
+                    "audit boot barrier failed; sweeping anyway "
+                    "(first sweep may run on partially ingested state)",
+                    process="audit",
+                    trace_id=trace_id,
+                    err=e,
+                )
         while not self._stop.is_set():
             t0 = time.monotonic()
             try:

@@ -93,6 +93,14 @@ class Driver(ABC):
         micro-batching webhook's entry point)."""
         return [self.query(path, i, tracing) for i in inputs]
 
+    def query_host(self, path: str, input: Any = None) -> Response:
+        """Host-only query: the degraded rung of the admission ladder
+        (docs/robustness.md). Engines whose `query` already runs on the
+        host inherit this; the TPU driver overrides it to pin the
+        evaluation to the interpreter so a faulted device is never paid
+        a second doomed attempt."""
+        return self.query(path, input)
+
     @abstractmethod
     def dump(self) -> str: ...
 

@@ -51,6 +51,7 @@ from ..analysis import (
     analyze_modules,
 )
 from ..engine.matchkernel import matchspec_to_np
+from ..faults import fire
 from ..engine.matchspec import compile_match_specs
 from ..engine.patterns import PatternRegistry
 from ..engine.programs import Program, ProgramEvaluator, compile_program
@@ -68,7 +69,7 @@ from ..rego import ast as A
 from ..rego.interp import RegoError, Undefined, _call_function
 from ..rego.values import freeze, thaw
 from . import match as M
-from .driver import RegoDriver, _autoreject_result, _cname
+from .driver import _HOOK_RE, RegoDriver, _autoreject_result, _cname
 from .types import Response, Result
 
 _TEMPLATE_PREFIX_RE = re.compile(r'^templates\["([^"]+)"\]\["([^"]+)"\]$')
@@ -731,6 +732,10 @@ class TpuDriver(RegoDriver):
         if needed:
             feats = self._row_feature_bits(target, corpus, needed)
             self.kernel.stage_row_feats(stacked, feats)
+        # named fault point (docs/robustness.md): "error" simulates a
+        # failing device dispatch, "hang" a stalled one — exercised by
+        # the chaos suite to drive the real degradation ladder
+        fire("driver.device_dispatch")
         # the whole sweep: one device execution, one fetch
         packed, hot, n_hot, sc, si = self.kernel.dispatch_need_all(
             policy, stacked, (corpus.g, corpus.g1),
@@ -922,6 +927,7 @@ class TpuDriver(RegoDriver):
     def _need_pairs_np(self, cs, corpus, ns_cache, n):
         """Numpy path (use_jax=False): same pair semantics, eager host
         eval — used by tests that pin device/host equivalence."""
+        fire("driver.device_dispatch")
         compiled = [p for p in cs.programs if p is not None]
         match = np.zeros((len(cs.constraints), n), bool)
         for i, c in enumerate(cs.constraints):
@@ -1011,6 +1017,24 @@ class TpuDriver(RegoDriver):
                     for i in inputs
                 ]
         return self._query_many_device(target, inputs)
+
+    def query_host(self, path: str, input: Any = None) -> Response:
+        """The host-oracle rung of the degradation ladder: evaluate on
+        the INTERPRETER, never touching the device — the path the
+        webhook's circuit breaker degrades to when the fused dispatch
+        is failing (a faulted device must not be paid a second doomed
+        attempt per request). Results are bit-identical to the fused
+        path by the driver-parity contract."""
+        m = _HOOK_RE.match(path)
+        if m is None:
+            raise ValueError(f"unsupported query path: {path!r}")
+        target, hook = m.group(1), m.group(2)
+        with self._mutex:
+            if hook == "violation":
+                results = RegoDriver._violation(self, target, input or {}, None)
+            else:
+                results = RegoDriver._audit(self, target, None)
+        return Response(target=target, results=results)
 
     # -- serve-while-compiling (cold-start) ----------------------------------
 
@@ -1110,6 +1134,10 @@ class TpuDriver(RegoDriver):
                 ones = np.ones(len(corpus.reviews), bool)
                 corpus.row_feats = {name: ones for name in needed}
         try:
+            # named fault point: "hang" simulates an XLA compile stall
+            # (tens of seconds is realistic), "error" a compile failure
+            # — the serving route must stay on the interpreter either way
+            fire("driver.compile")
             self._need_pairs(target, cs, corpus)
         except Exception:
             return False

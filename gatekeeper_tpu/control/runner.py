@@ -89,6 +89,13 @@ class Runner:
         # obs.Tracer threaded through webhook + audit; None builds one
         # (tracing is always on — the ring is bounded)
         tracer=None,
+        # overload/degradation envelope (docs/robustness.md): what a
+        # shed/expired/unevaluable request gets ("open" = allow, the
+        # reference's failurePolicy: Ignore posture; "closed" = 503)
+        # and the admission queue bound (None = unbounded; default
+        # mirrors webhook.server.DEFAULT_MAX_QUEUE)
+        fail_policy: str = "open",
+        max_queue=2048,
     ):
         from ..logs import null_logger
         from ..obs import Tracer
@@ -133,6 +140,8 @@ class Runner:
         self._profile_lock = threading.Lock()
         self.webhook_port = webhook_port
         self.readyz_port = readyz_port
+        self.fail_policy = fail_policy
+        self.max_queue = max_queue
         self.exempt_namespaces = list(exempt_namespaces)
         self.webhook_tls = webhook_tls
         self.vwh_name = vwh_name
@@ -340,6 +349,8 @@ class Runner:
                 mutation_system=self.mutation_system,
                 cert_dir=self.cert_dir,
                 bind_addr=self.bind_addr,
+                fail_policy=self.fail_policy,
+                max_queue=self.max_queue,
             )
             self.webhook.start()
             if self.vwh_name and self.webhook.rotator is not None:
@@ -620,6 +631,33 @@ class Runner:
                             ),
                             "errors": runner.audit.error_count,
                         }
+                    if runner.webhook is not None:
+                        # overload/degradation envelope health
+                        # (docs/robustness.md): breaker state answers
+                        # "why is admission on the interpreter", shed
+                        # counts answer "are we dropping load"
+                        wh = {
+                            "fail_policy": runner.fail_policy,
+                            "shed": runner.webhook.batcher.shed_count,
+                            "batch_failures": (
+                                runner.webhook.batcher.batch_failures
+                            ),
+                        }
+                        breaker = runner.webhook.batcher.breaker
+                        if breaker is not None:
+                            wh["breaker"] = breaker.snapshot()
+                        mb = runner.webhook.mutate_batcher
+                        if mb is not None:
+                            wh["mutation"] = {
+                                "shed": mb.shed_count,
+                                "batch_failures": mb.batch_failures,
+                                **(
+                                    {"breaker": mb.breaker.snapshot()}
+                                    if mb.breaker is not None
+                                    else {}
+                                ),
+                            }
+                        stats["webhook"] = wh
                     drv = getattr(runner.client, "_driver", None)
                     if drv is not None and hasattr(drv, "stats"):
                         # engine routing health (docs/metrics.md): WHY

@@ -1,0 +1,43 @@
+"""Robustness toolkit for the admission plane: deterministic fault
+injection (`injection.py`), the device circuit breaker (`breaker.py`),
+and the overload/degradation error taxonomy (`errors.py`). The failure
+envelope, degradation ladder, and fault-point catalog are documented in
+docs/robustness.md.
+"""
+
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from .errors import (
+    AdmissionUnavailable,
+    DeadlineExceeded,
+    EvaluationTimeout,
+    EvaluationUnavailable,
+    ShedError,
+)
+from .injection import (
+    FAULTS,
+    FaultError,
+    FaultRegistry,
+    FaultSpec,
+    configure_from_env,
+    fire,
+    skew,
+)
+
+__all__ = [
+    "AdmissionUnavailable",
+    "CircuitBreaker",
+    "CLOSED",
+    "DeadlineExceeded",
+    "EvaluationTimeout",
+    "EvaluationUnavailable",
+    "FAULTS",
+    "FaultError",
+    "FaultRegistry",
+    "FaultSpec",
+    "HALF_OPEN",
+    "OPEN",
+    "ShedError",
+    "configure_from_env",
+    "fire",
+    "skew",
+]

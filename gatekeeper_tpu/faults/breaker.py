@@ -1,0 +1,179 @@
+"""Circuit breaker around the fused device path.
+
+Before this existed, a persistently failing device made EVERY batch pay
+a doomed fused attempt plus N serial fallbacks — the failure tax scaled
+with traffic exactly when the system was least healthy. The breaker
+converts that into a state machine with an explicit, observable
+envelope:
+
+    CLOSED ──k consecutive failures──▶ OPEN
+      ▲                                 │ recovery_seconds elapse
+      │ probe succeeds                  ▼
+      └───────────────────────────  HALF_OPEN ──probe fails──▶ OPEN
+
+  * CLOSED: fused dispatches flow; consecutive failures are counted
+    (any success resets the count).
+  * OPEN: the fused path is short-circuited — batches go straight to
+    the host-interpreter degraded mode, paying zero doomed device
+    attempts. After `recovery_seconds` the breaker half-opens.
+  * HALF_OPEN: exactly ONE batch is admitted as a probe; success closes
+    the breaker, failure re-opens it (and restarts the recovery clock).
+
+Every transition gets a Prometheus series (`device_breaker_state`,
+`device_breaker_transitions_total`, `device_breaker_probes_total`) and
+a tracer span, so a dashboard — not a log dive — answers "why is
+admission on the interpreter right now".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# gauge encoding for device_breaker_state
+_STATE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with half-open probes.
+
+    Thread-safe; `allow()` / `record_success()` / `record_failure()`
+    are the whole contract. `clock` is injectable so tests advance the
+    recovery window deterministically."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        recovery_seconds: float = 30.0,
+        plane: str = "validation",
+        metrics=None,
+        tracer=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.recovery_seconds = recovery_seconds
+        self.plane = plane
+        self.metrics = metrics
+        self.tracer = tracer
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_in_flight = False
+        self.transitions = 0  # lifetime transition count (tests/readyz)
+        self._export_state()
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def snapshot(self) -> dict:
+        """Readyz/debug view of the breaker."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "transitions": self.transitions,
+                "probe_in_flight": self._probe_in_flight,
+            }
+
+    def _maybe_half_open_locked(self) -> None:
+        if (
+            self._state == OPEN
+            and self._opened_at is not None
+            and self._clock() - self._opened_at >= self.recovery_seconds
+        ):
+            self._transition_locked(HALF_OPEN)
+
+    def _transition_locked(self, to_state: str) -> None:
+        from_state = self._state
+        if from_state == to_state:
+            return
+        self._state = to_state
+        self.transitions += 1
+        if to_state == OPEN:
+            self._opened_at = self._clock()
+            self._probe_in_flight = False
+        elif to_state == HALF_OPEN:
+            self._probe_in_flight = False
+        else:  # CLOSED
+            self._consecutive_failures = 0
+            self._opened_at = None
+            self._probe_in_flight = False
+        self._export_state()
+        if self.metrics is not None:
+            self.metrics.record(
+                "device_breaker_transitions_total", 1, plane=self.plane,
+                from_state=from_state, to_state=to_state,
+            )
+        if self.tracer is not None:
+            # a standalone one-span trace: transitions are rare and must
+            # be findable in /debug/traces without a request to ride on
+            with self.tracer.start_span(
+                "breaker_transition", plane=self.plane,
+                from_state=from_state, to_state=to_state,
+            ):
+                pass
+
+    def _export_state(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "device_breaker_state", _STATE_VALUE[self._state],
+                plane=self.plane,
+            )
+
+    # -- the contract --------------------------------------------------------
+
+    def allow(self) -> bool:
+        """May this batch take the fused device path? OPEN → no;
+        HALF_OPEN → yes for exactly one probe batch at a time."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and not self._probe_in_flight:
+                self._probe_in_flight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                if self.metrics is not None:
+                    self.metrics.record(
+                        "device_breaker_probes_total", 1,
+                        plane=self.plane, result="success",
+                    )
+                self._transition_locked(CLOSED)
+            else:
+                self._consecutive_failures = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                if self.metrics is not None:
+                    self.metrics.record(
+                        "device_breaker_probes_total", 1,
+                        plane=self.plane, result="failure",
+                    )
+                self._transition_locked(OPEN)
+                return
+            self._consecutive_failures += 1
+            if (
+                self._state == CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._transition_locked(OPEN)

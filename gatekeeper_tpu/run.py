@@ -20,6 +20,9 @@ with the selected operations; flags mirror the reference's surface:
   --vwh-name             ValidatingWebhookConfiguration to keep
                          injected with the rotating CA bundle
   --enable-pprof         JAX profiler endpoint on the health server
+  --fail-policy          open|closed — what a shed/expired/unevaluable
+                         request gets (docs/robustness.md)
+  --max-queue            admission queue bound (0 = unbounded)
   --kube-url/--kube-token/--kube-ca  out-of-cluster apiserver access
 """
 
@@ -56,6 +59,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cert-dir", default="/certs")
     p.add_argument("--vwh-name", default="")
     p.add_argument("--enable-pprof", action="store_true")
+    # overload/degradation envelope (docs/robustness.md): the response
+    # a shed/expired/unevaluable request gets, and the admission queue
+    # bound (0 = unbounded). Chaos faults arm via GATEKEEPER_TPU_FAULTS.
+    p.add_argument("--fail-policy", default="open",
+                   choices=["open", "closed"])
+    p.add_argument("--max-queue", type=int, default=2048)
     p.add_argument("--kube-url", default=None)
     p.add_argument("--kube-token", default=None)
     p.add_argument("--kube-ca", default=None)
@@ -107,6 +116,10 @@ def build_runner(args, log=None, webhook_tls: bool = True):
         logger=log,
         vwh_name=args.vwh_name or None,
         cert_dir=args.cert_dir,
+        fail_policy=getattr(args, "fail_policy", "open"),
+        max_queue=(
+            getattr(args, "max_queue", 2048) or None
+        ),  # 0 -> unbounded
         bind_addr="0.0.0.0",  # kubelet probes and the apiserver dial
         # the pod IP, not loopback
     )

@@ -25,6 +25,7 @@ import subprocess
 import threading
 from typing import Optional
 
+from ..faults import fire
 from ..logs import null_logger
 
 
@@ -145,6 +146,11 @@ class BatchBridgeServer:
 
     def _process(self, frame: bytes) -> bytes:
         try:
+            # named fault point (docs/robustness.md): "error" simulates
+            # a backend processing crash (the frame gets the 500 doc and
+            # the frontend's --deadline-ms fail-open is the backstop);
+            # "hang" a stalled backend worker
+            fire("bridge.process")
             path, _, body = frame.partition(b"\n")
             handler = self.handler
             if path == b"/v1/admitlabel" and self.label_handler is not None:
@@ -188,14 +194,21 @@ class BridgeStack:
         exempt_namespaces=(),
         metrics=None,
         tracer=None,
+        max_queue=None,
         **handler_kwargs,
     ):
         from .namespacelabel import NamespaceLabelHandler
-        from .server import BatchedValidationHandler, MicroBatcher
+        from .server import (
+            DEFAULT_MAX_QUEUE,
+            BatchedValidationHandler,
+            MicroBatcher,
+        )
 
         self.batcher = MicroBatcher(
             client, target, window_ms=window_ms,
             metrics=metrics, tracer=tracer,
+            max_queue=max_queue if max_queue is not None
+            else DEFAULT_MAX_QUEUE,
         )
         handler_kwargs.setdefault("metrics", metrics)
         handler_kwargs.setdefault("tracer", tracer)

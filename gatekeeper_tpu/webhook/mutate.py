@@ -26,11 +26,18 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any, Dict, List, Tuple
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Any, Dict, List, Optional, Tuple
 
+from ..faults import (
+    AdmissionUnavailable,
+    EvaluationTimeout,
+    EvaluationUnavailable,
+    fire,
+)
 from ..mutation import ConvergenceError, MutationApplyError, json_patch
-from .policy import SERVICE_ACCOUNT, AdmissionResponse
-from .server import DEFAULT_REQUEST_TIMEOUT, MicroBatcher
+from .policy import SERVICE_ACCOUNT, AdmissionResponse, unavailable_response
+from .server import DEFAULT_MAX_QUEUE, DEFAULT_REQUEST_TIMEOUT, MicroBatcher
 
 # mutators act on the incoming object; DELETE carries none
 _MUTATE_OPERATIONS = ("CREATE", "UPDATE", "")
@@ -38,7 +45,16 @@ _MUTATE_OPERATIONS = ("CREATE", "UPDATE", "")
 
 class MutateBatcher(MicroBatcher):
     """MicroBatcher whose fused dispatch is screen→apply→render over a
-    MutationSystem instead of Client.review_many."""
+    MutationSystem instead of Client.review_many. Inherits the full
+    overload/degradation envelope (bounded queue, deadline shedding,
+    device circuit breaker) with the mutate-plane specifics: the
+    breaker gates the DEVICE SCREEN, the host-oracle rung is
+    `MutationSystem.screen_host`, and convergence failures are never
+    softened by the envelope — an unconverged object is rejected no
+    matter what state the ladder is in."""
+
+    # plane tag on shed/breaker/queue metrics (docs/robustness.md)
+    plane = "mutation"
 
     def __init__(
         self,
@@ -48,6 +64,8 @@ class MutateBatcher(MicroBatcher):
         namespace_getter=None,
         metrics=None,
         tracer=None,
+        max_queue: Optional[int] = DEFAULT_MAX_QUEUE,
+        breaker=None,
     ):
         super().__init__(
             client=None,
@@ -57,15 +75,20 @@ class MutateBatcher(MicroBatcher):
             namespace_getter=namespace_getter,
             metrics=metrics,
             tracer=tracer,
+            max_queue=max_queue,
+            breaker=breaker,
         )
         self.system = system
 
     # -- the mutate dispatch -------------------------------------------------
 
-    def _dispatch(self, batch: List[Tuple[Dict[str, Any], Any, Any, Tuple]]):
+    def _dispatch(self, batch: List[Tuple]):
+        batch = self._strip_expired(batch)
+        if not batch:
+            return
         wall0, t0 = time.time(), time.perf_counter()
         reviews = []
-        for request, _, _, _ in batch:
+        for request, _, _, _, _ in batch:
             review = dict(request)
             ns_obj = None
             namespace = request.get("namespace", "")
@@ -76,18 +99,50 @@ class MutateBatcher(MicroBatcher):
             reviews.append(review)
 
         t_scr = time.perf_counter()
-        try:
-            muts, matrix = self.system.screen(reviews)
-            route = "batched"
-        except Exception:
-            # device-screen failure degrades to the host oracle — the
-            # mutation plane keeps answering (fail-open on the SCREEN,
-            # never on convergence)
-            self.batch_failures += 1
+        breaker = self.breaker
+        muts = matrix = None
+        route = "batched"
+        if breaker is not None and not breaker.allow():
+            # breaker open: the device screen has been failing — go
+            # straight to the host-oracle screen, paying zero doomed
+            # device attempts for this batch
             if self.metrics is not None:
-                self.metrics.record("mutation_batch_failures_total", 1)
-            muts, matrix = self.system.screen_host(reviews)
-            route = "fallback"
+                self.metrics.record(
+                    "webhook_degraded_dispatch_total", 1, plane=self.plane
+                )
+            route = "degraded"
+        else:
+            try:
+                fire("mutate.screen_dispatch")
+                muts, matrix = self.system.screen(reviews)
+                if breaker is not None:
+                    breaker.record_success()
+            except Exception:
+                # device-screen failure degrades to the host oracle —
+                # the mutation plane keeps answering (fail-open on the
+                # SCREEN, never on convergence)
+                if breaker is not None:
+                    breaker.record_failure()
+                self.batch_failures += 1
+                if self.metrics is not None:
+                    self.metrics.record("mutation_batch_failures_total", 1)
+                route = "fallback"
+        if muts is None:
+            try:
+                fire("mutate.host_screen")
+                muts, matrix = self.system.screen_host(reviews)
+            except Exception as e:
+                # every rung down: the typed unavailability the handler
+                # answers with the endpoint's fail policy (the apiserver
+                # would admit unmutated on webhook failure too — here it
+                # is explicit and counted). NEVER a half-screened batch.
+                for _, fut, ctx, (sub_wall, _sp), _ in batch:
+                    fut.set_exception(EvaluationUnavailable(str(e)))
+                    self._record_mutate_spans(
+                        ctx, sub_wall, wall0, wall0, 0.0, 0.0, 0.0,
+                        len(batch), 0, "unavailable",
+                    )
+                return
         screen_s = time.perf_counter() - t_scr
 
         self.batches_dispatched += 1
@@ -97,7 +152,7 @@ class MutateBatcher(MicroBatcher):
             self.metrics.observe("mutation_screen_batch_size", len(batch))
 
         wall_scr_end = wall0 + (time.perf_counter() - t0)
-        for i, ((request, fut, ctx, (sub_wall, _)), review) in enumerate(
+        for i, ((request, fut, ctx, (sub_wall, _), _dl), review) in enumerate(
             zip(batch, reviews)
         ):
             selected = [m for j, m in enumerate(muts) if matrix[j, i]]
@@ -178,9 +233,19 @@ class MutationHandler:
         request_timeout: float = DEFAULT_REQUEST_TIMEOUT,
         logger=None,
         tracer=None,
+        # same envelope semantics as ValidationHandler: what a request
+        # that could NOT be screened/applied (shed, expired, every rung
+        # down) gets. Convergence failures stay 500 regardless — an
+        # unconverged object is NEVER admitted.
+        fail_policy: str = "open",
     ):
         from ..logs import null_logger
 
+        if fail_policy not in ("open", "closed"):
+            raise ValueError(
+                f"fail_policy must be 'open' or 'closed', got {fail_policy!r}"
+            )
+        self.fail_policy = fail_policy
         self.batcher = batcher
         self.excluder = excluder
         self.metrics = metrics
@@ -245,12 +310,24 @@ class MutationHandler:
             return AdmissionResponse(
                 True, "Namespace is set to be ignored by Gatekeeper config"
             )
+        # deadline propagation: the request's remaining budget rides to
+        # the batch worker so expiry is checked BEFORE the screen
+        deadline = self.batcher._now() + self.request_timeout
+        fut = self.batcher.submit(
+            request, span_ctx=getattr(span, "context", None),
+            deadline=deadline,
+        )
         try:
-            patch = self.batcher.submit(
-                request, span_ctx=getattr(span, "context", None)
-            ).result(timeout=self.request_timeout)
+            try:
+                patch = fut.result(timeout=self.request_timeout)
+            except _FutureTimeout:
+                raise EvaluationTimeout(
+                    f"mutation evaluation exceeded {self.request_timeout}s"
+                ) from None
         except (ConvergenceError, MutationApplyError) as e:
-            # NEVER admit a non-converged / half-mutable object
+            # NEVER admit a non-converged / half-mutable object — this
+            # stays a hard 500 even under fail-open (the envelope covers
+            # requests that were never evaluated, not poisoned ones)
             self.log.error(
                 "mutation failed",
                 process="mutation",
@@ -259,6 +336,14 @@ class MutationHandler:
                 resource_namespace=namespace,
             )
             return AdmissionResponse(False, str(e), code=500)
+        except AdmissionUnavailable as e:
+            # shed / expired / every screen rung down: the fail-policy
+            # envelope (fail-open admits UNMUTATED — exactly what the
+            # apiserver's failurePolicy: Ignore would do on timeout)
+            return unavailable_response(
+                e, fail_policy=self.fail_policy, metrics=self.metrics,
+                log=self.log, span=span, plane="mutation",
+            )
         except Exception as e:
             return AdmissionResponse(False, str(e), code=500)
         return AdmissionResponse(True, "", patch=patch or None)

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..constraint import AugmentedReview
 from ..constraint.errors import ConstraintFrameworkError
 from ..control import PROCESS_WEBHOOK, Excluder
+from ..faults import AdmissionUnavailable
 
 SERVICE_ACCOUNT_NAMESPACE = "gatekeeper-system"
 SERVICE_ACCOUNT = (
@@ -103,6 +104,50 @@ class AdmissionResponse:
         return out
 
 
+def unavailable_response(
+    e: AdmissionUnavailable,
+    fail_policy: str,
+    metrics=None,
+    log=None,
+    span=None,
+    plane: str = "validation",
+) -> AdmissionResponse:
+    """The bottom rung of the degradation ladder, shared by every
+    admission plane (validation / mutation): the request was never
+    evaluated (shed / expired / every rung down) — answer with the
+    endpoint's fail-open/fail-closed envelope instead of a raw 500,
+    explicitly and countably. Mirrors what the apiserver's
+    failurePolicy would do on a webhook timeout, but within the
+    caller's deadline."""
+    if metrics is not None:
+        metrics.record(
+            "webhook_unavailable_responses_total", 1,
+            plane=plane, policy=fail_policy, reason=e.reason,
+        )
+    if span is not None:
+        span.set_attr(unavailable_reason=e.reason)
+    if log is not None:
+        log.error(
+            "admission evaluation unavailable",
+            process="admission",
+            plane=plane,
+            reason=e.reason,
+            fail_policy=fail_policy,
+            err=e,
+        )
+    if fail_policy == "closed":
+        return AdmissionResponse(
+            False,
+            f"admission evaluation unavailable ({e.reason}): {e}",
+            code=503,
+        )
+    return AdmissionResponse(
+        True,
+        f"admission evaluation unavailable ({e.reason}); "
+        f"failing open: {e}",
+    )
+
+
 class ValidationHandler:
     def __init__(
         self,
@@ -118,9 +163,20 @@ class ValidationHandler:
         trace_log: Optional[Callable[[str], None]] = None,
         logger=None,
         tracer=None,
+        # what a request that could NOT be evaluated (shed under
+        # overload, deadline expired, every evaluation rung down) gets:
+        # "open" allows (the reference's failurePolicy: Ignore posture —
+        # audit is the backstop), "closed" denies with a 503. Evaluation
+        # ERRORS (a poisoned request) remain 500s regardless.
+        fail_policy: str = "open",
     ):
         from ..logs import null_logger
 
+        if fail_policy not in ("open", "closed"):
+            raise ValueError(
+                f"fail_policy must be 'open' or 'closed', got {fail_policy!r}"
+            )
+        self.fail_policy = fail_policy
         self.client = client
         # optional obs.Tracer: every handled request becomes a trace
         # (span taxonomy in docs/observability.md); denial log records
@@ -224,6 +280,8 @@ class ValidationHandler:
             trace_enabled, dump = self.trace_config.level(request)
         try:
             results = self._review(request, tracing=trace_enabled, span=span)
+        except AdmissionUnavailable as e:
+            return self._unavailable_response(e, span)
         except Exception as e:
             return AdmissionResponse(False, str(e), code=500)
         if dump:
@@ -233,6 +291,14 @@ class ValidationHandler:
         if msgs:
             return AdmissionResponse(False, "\n".join(msgs), code=403)
         return AdmissionResponse(True, "")
+
+    def _unavailable_response(
+        self, e: AdmissionUnavailable, span=None, plane: str = "validation"
+    ) -> AdmissionResponse:
+        return unavailable_response(
+            e, fail_policy=self.fail_policy, metrics=self.metrics,
+            log=self.log, span=span, plane=plane,
+        )
 
     # -- pieces --------------------------------------------------------------
 

@@ -13,10 +13,18 @@ the Go webhook pod. With `tls=True` it terminates HTTPS with a
 rotating self-signed CA + server cert (`certs.CertRotator`, the
 pkg/webhook/certs.go counterpart).
 
-Failure semantics preserve the reference's fail-open design (SURVEY §5):
-a failed fused batch falls back to per-request CPU-path evaluation, and
-only a request whose own fallback also fails gets an error response —
-one poisoned request can no longer 500 a whole batch.
+Failure semantics preserve the reference's fail-open design (SURVEY §5)
+and make the whole degradation ladder explicit (docs/robustness.md):
+a failed fused batch falls back to per-request HOST-interpreter
+evaluation (never a second doomed device attempt), a circuit breaker
+(`faults.CircuitBreaker`) short-circuits the fused path entirely after
+K consecutive batch failures, and only a request whose own host
+evaluation also fails gets an error response — one poisoned request can
+no longer 500 a whole batch. Overload protection: the admission queue
+is bounded (`max_queue`) with load shedding, and requests carry their
+caller deadline so an already-expired request is shed before dispatch
+instead of evaluated and discarded. Shed/degraded requests get the
+endpoint's fail-open/fail-closed envelope, not a raw 500.
 """
 
 from __future__ import annotations
@@ -25,16 +33,32 @@ import json
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..constraint import AugmentedReview
+from ..faults import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    EvaluationTimeout,
+    EvaluationUnavailable,
+    FaultError,
+    ShedError,
+    fire,
+    skew,
+)
 from .namespacelabel import NamespaceLabelHandler
 from .policy import AdmissionResponse, ValidationHandler
 
 # the K8s webhook timeoutSeconds ceiling is 30s and Gatekeeper deploys
 # with 3s; our per-request deadline stays safely under the ceiling
 DEFAULT_REQUEST_TIMEOUT = 10.0
+
+# bounded admission queue: at max_batch=256 and low-ms batch drains this
+# is seconds of backlog — anything deeper is already past every caller
+# deadline, so evaluating it would be pure waste (shed instead)
+DEFAULT_MAX_QUEUE = 2048
 
 
 def review_envelope(
@@ -83,6 +107,10 @@ class MicroBatcher:
     `Client.review_many` call for the whole batch.
     """
 
+    # the plane tag on shed/breaker/queue metrics (MutateBatcher
+    # overrides with "mutation")
+    plane = "validation"
+
     def __init__(
         self,
         client,
@@ -92,19 +120,33 @@ class MicroBatcher:
         namespace_getter: Optional[Callable[[str], Optional[dict]]] = None,
         metrics=None,
         tracer=None,
+        # bounded admission queue (overload shedding); None = unbounded
+        max_queue: Optional[int] = DEFAULT_MAX_QUEUE,
+        # device circuit breaker: None = construct the default; False =
+        # disabled; or pass a faults.CircuitBreaker to share/observe
+        breaker=None,
     ):
         self.client = client
         self.target = target
         self.window = window_ms / 1000.0
         self.max_batch = max_batch
+        self.max_queue = max_queue
         self.namespace_getter = namespace_getter
         self.metrics = metrics
         # obs.Tracer: the batch worker stamps queue-wait + dispatch +
         # render spans into EVERY member request's trace (the shared
         # batch window, recorded per trace so each is self-contained)
         self.tracer = tracer
-        # (request, future, span ctx | None, (wall, perf) submit stamp)
-        self._pending: List[Tuple[Dict[str, Any], Future, Any, Tuple]] = []
+        if breaker is None:
+            breaker = CircuitBreaker(
+                plane=self.plane, metrics=metrics, tracer=tracer
+            )
+        self.breaker: Optional[CircuitBreaker] = breaker or None
+        # (request, future, span ctx | None, (wall, perf) submit stamp,
+        #  monotonic deadline | None)
+        self._pending: List[
+            Tuple[Dict[str, Any], Future, Any, Tuple, Optional[float]]
+        ] = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -112,6 +154,7 @@ class MicroBatcher:
         self.batches_dispatched = 0
         self.requests_batched = 0
         self.batch_failures = 0
+        self.shed_count = 0
 
     def start(self) -> None:
         if self._thread is None:
@@ -134,20 +177,80 @@ class MicroBatcher:
         if leftover:
             self._dispatch(leftover)
 
-    def submit(self, request: Dict[str, Any], span_ctx=None) -> Future:
+    def _now(self) -> float:
+        """The batcher's deadline clock: monotonic plus any injected
+        clock-jump skew (fault point `webhook.clock`) so chaos runs can
+        simulate NTP steps without touching the real clock."""
+        return time.monotonic() + skew("webhook.clock")
+
+    def _shed(self, fut: Future, exc: Exception, reason: str,
+              ctx=None, sub_wall: Optional[float] = None) -> None:
+        """Resolve a future without evaluation: counted, traced, and
+        typed so the handler answers with the fail policy envelope."""
+        with self._lock:  # sheds race from concurrent submit threads
+            self.shed_count += 1
+        if self.metrics is not None:
+            self.metrics.record(
+                "webhook_shed_total", 1, plane=self.plane, reason=reason
+            )
+        if self.tracer is not None and ctx is not None:
+            now = time.time()
+            self.tracer.record_span(
+                "shed", sub_wall if sub_wall is not None else now, now,
+                parent=ctx, reason=reason, plane=self.plane,
+            )
+        fut.set_exception(exc)
+
+    def submit(self, request: Dict[str, Any], span_ctx=None,
+               deadline: Optional[float] = None) -> Future:
+        """Enqueue for the next fused dispatch. `deadline` is a
+        monotonic timestamp (the caller's remaining budget): a request
+        that is already expired — or expires while queued — is shed
+        with DeadlineExceeded instead of ever reaching a dispatch."""
         fut: Future = Future()
         stamp = (time.time(), time.perf_counter())
+        if deadline is not None and self._now() >= deadline:
+            # expired before enqueue: never pay queue + dispatch for an
+            # answer nobody is waiting for
+            self._shed(
+                fut,
+                DeadlineExceeded("request deadline expired before enqueue"),
+                "deadline", ctx=span_ctx, sub_wall=stamp[0],
+            )
+            return fut
+        overloaded = False
         with self._lock:
             stopped = self._stop
             if not stopped:
-                self._pending.append((request, fut, span_ctx, stamp))
-                n = len(self._pending)
+                if (
+                    self.max_queue is not None
+                    and len(self._pending) >= self.max_queue
+                ):
+                    overloaded = True
+                else:
+                    self._pending.append(
+                        (request, fut, span_ctx, stamp, deadline)
+                    )
+                    n = len(self._pending)
         if stopped:
             # worker is gone (and stop() may have already drained its
             # leftovers): dispatch inline so the caller never hangs
-            self._dispatch([(request, fut, span_ctx, stamp)])
-        elif n == 1 or n >= self.max_batch:
-            self._wake.set()
+            self._dispatch([(request, fut, span_ctx, stamp, deadline)])
+        elif overloaded:
+            self._shed(
+                fut,
+                ShedError(
+                    f"admission queue full ({self.max_queue} pending)"
+                ),
+                "queue_full", ctx=span_ctx, sub_wall=stamp[0],
+            )
+        else:
+            if self.metrics is not None:
+                self.metrics.gauge(
+                    "admission_queue_depth", n, plane=self.plane
+                )
+            if n == 1 or n >= self.max_batch:
+                self._wake.set()
         return fut
 
     def _loop(self) -> None:
@@ -172,50 +275,112 @@ class MicroBatcher:
             with self._lock:
                 batch = self._pending
                 self._pending = []
+            if self.metrics is not None:
+                self.metrics.gauge(
+                    "admission_queue_depth", 0, plane=self.plane
+                )
             if batch:
                 self._dispatch(batch)
             if self._stop:
                 return
 
-    def _dispatch(self, batch: List[Tuple[Dict[str, Any], Future, Any, Tuple]]) -> None:
+    def _strip_expired(self, batch):
+        """Deadline propagation: requests whose caller deadline expired
+        while queued are shed here — before any dispatch — instead of
+        evaluated and discarded."""
+        now = self._now()
+        live = []
+        for item in batch:
+            _, fut, ctx, stamp, deadline = item
+            if deadline is not None and now >= deadline:
+                self._shed(
+                    fut,
+                    DeadlineExceeded(
+                        "request deadline expired while queued"
+                    ),
+                    "deadline", ctx=ctx, sub_wall=stamp[0],
+                )
+            else:
+                live.append(item)
+        return live
+
+    def _dispatch(self, batch) -> None:
+        batch = self._strip_expired(batch)
+        if not batch:
+            return
         wall0, t0 = time.time(), time.perf_counter()
         reviews = []
-        for request, _, _, _ in batch:
+        for request, _, _, _, _ in batch:
             ns_obj = None
             namespace = request.get("namespace", "")
             if namespace and self.namespace_getter is not None:
                 ns_obj = self.namespace_getter(namespace)
             reviews.append(AugmentedReview(request, namespace=ns_obj))
+        breaker = self.breaker
+        if breaker is not None and not breaker.allow():
+            # breaker open: the fused path has been failing — go
+            # straight to the host-interpreter degraded mode, paying
+            # zero doomed device attempts for this batch
+            if self.metrics is not None:
+                self.metrics.record(
+                    "webhook_degraded_dispatch_total", 1, plane=self.plane
+                )
+            self._dispatch_host(batch, reviews, wall0, t0, route="degraded")
+            return
         try:
+            fire("webhook.batch_dispatch")
             all_responses = self.client.review_many(reviews)
         except Exception:
-            # fused-path failure: fall back PER REQUEST to the serial
-            # review path so one poisoned request (or a device fault)
-            # cannot fail the whole batch — requests still get correct
-            # answers and only their own failure surfaces to them
+            # fused-path failure: degrade to the host-oracle rung so
+            # one poisoned request (or a device fault) cannot fail the
+            # whole batch — requests still get correct answers and only
+            # their own failure surfaces to them
+            if breaker is not None:
+                breaker.record_failure()
             self.batch_failures += 1
             if self.metrics is not None:
                 self.metrics.record("webhook_batch_failures_total", 1)
-            for review, (_, fut, _, _) in zip(reviews, batch):
-                try:
-                    responses = self.client.review(review)
-                    resp = responses.by_target.get(self.target)
-                    fut.set_result(
-                        resp.results if resp is not None else []
-                    )
-                except Exception as e:
-                    fut.set_exception(e)
-            self._record_spans(batch, wall0, t0, route="fallback")
+            self._dispatch_host(batch, reviews, wall0, t0, route="fallback")
             return
+        if breaker is not None:
+            breaker.record_success()
         self.batches_dispatched += 1
         self.requests_batched += len(batch)
         if self.metrics is not None:
             self.metrics.record("webhook_batches_total", 1)
             self.metrics.observe("webhook_batch_size", len(batch))
         self._record_spans(batch, wall0, t0, route="batched")
-        for (_, fut, _, _), responses in zip(batch, all_responses):
+        for (_, fut, _, _, _), responses in zip(batch, all_responses):
             resp = responses.by_target.get(self.target)
             fut.set_result(resp.results if resp is not None else [])
+
+    def _dispatch_host(self, batch, reviews, wall0: float, t0: float,
+                       route: str) -> None:
+        """The host-oracle rung of the degradation ladder: per-request
+        INTERPRETER evaluation (`Client.review_host` — never a second
+        device attempt). A request whose own host evaluation fails
+        keeps its error (a poisoned request is still a 500); only when
+        the host plane itself is down does the batch fall to the final
+        rung — the typed EvaluationUnavailable that the handler answers
+        with the endpoint's fail-open/fail-closed envelope."""
+        try:
+            fire("webhook.host_review")
+        except FaultError as e:
+            for _, fut, _, _, _ in batch:
+                fut.set_exception(EvaluationUnavailable(str(e)))
+            self._record_spans(batch, wall0, t0, route="unavailable")
+            return
+        host = getattr(self.client, "review_host", None)
+        if host is None:
+            host = self.client.review
+        for review, (_, fut, _, _, _) in zip(reviews, batch):
+            try:
+                responses = host(review)
+                resp = responses.by_target.get(self.target)
+                fut.set_result(resp.results if resp is not None else [])
+            except Exception as e:
+                fut.set_exception(e)
+        self._record_spans(batch, wall0, t0, route=route)
 
     def _record_spans(self, batch, wall0: float, t0: float, route: str) -> None:
         """Stamp this batch's shared timing window into every traced
@@ -237,7 +402,7 @@ class MicroBatcher:
                 if k in stats:
                     attrs[k] = stats[k]
         render_s = phases.get("render", 0.0)
-        for _, _, ctx, (sub_wall, _sub_perf) in batch:
+        for _, _, ctx, (sub_wall, _sub_perf), _ in batch:
             if ctx is None:
                 continue
             self.tracer.record_span(
@@ -288,9 +453,19 @@ class BatchedValidationHandler(ValidationHandler):
             # by definition (the driver's batched path declines tracing)
             return super()._review(request, tracing=True, span=span)
         ctx = getattr(span, "context", None)
-        return self.batcher.submit(request, span_ctx=ctx).result(
-            timeout=self.request_timeout
-        )
+        # deadline propagation: the request's remaining budget rides to
+        # the batch worker so expiry is checked BEFORE dispatch
+        deadline = self.batcher._now() + self.request_timeout
+        fut = self.batcher.submit(request, span_ctx=ctx, deadline=deadline)
+        try:
+            return fut.result(timeout=self.request_timeout)
+        except _FutureTimeout:
+            # a hung dispatch (device stall): the caller gets the typed
+            # unavailability — answered per fail policy — while the
+            # worker finishes or dies in the background
+            raise EvaluationTimeout(
+                f"admission evaluation exceeded {self.request_timeout}s"
+            ) from None
 
 
 class WebhookServer:
@@ -321,6 +496,12 @@ class WebhookServer:
         # mutation.MutationSystem: wires the /v1/mutate plane (None =
         # endpoint returns 404, validation-only pod)
         mutation_system=None,
+        # overload / degradation envelope (docs/robustness.md):
+        # fail_policy is what a shed/expired/unevaluable request gets —
+        # "open" (allow; the reference's failurePolicy: Ignore posture)
+        # or "closed" (deny 503); max_queue bounds the admission queue
+        fail_policy: str = "open",
+        max_queue: Optional[int] = DEFAULT_MAX_QUEUE,
         # "127.0.0.1" keeps tests hermetic; in-cluster serving must bind
         # the pod IP surface ("0.0.0.0" via run.py) or the apiserver and
         # kubelet probes can never connect
@@ -332,6 +513,7 @@ class WebhookServer:
             client, target, window_ms=window_ms,
             namespace_getter=namespace_getter,
             metrics=metrics, tracer=tracer,
+            max_queue=max_queue,
         )
         self.mutate_batcher = None
         self.mutation_handler = None
@@ -343,6 +525,7 @@ class WebhookServer:
                 mutation_system, window_ms=window_ms,
                 namespace_getter=namespace_getter,
                 metrics=metrics, tracer=tracer,
+                max_queue=max_queue,
             )
             self.mutation_handler = MutationHandler(
                 self.mutate_batcher,
@@ -351,6 +534,7 @@ class WebhookServer:
                 request_timeout=request_timeout,
                 logger=logger,
                 tracer=tracer,
+                fail_policy=fail_policy,
             )
         self.handler = BatchedValidationHandler(
             self.batcher, excluder=excluder, metrics=metrics,
@@ -361,6 +545,7 @@ class WebhookServer:
             log_denies=log_denies,
             logger=logger,
             tracer=tracer,
+            fail_policy=fail_policy,
         )
         self.label_handler = NamespaceLabelHandler(exempt_namespaces)
         outer = self
