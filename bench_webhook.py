@@ -516,6 +516,194 @@ def run_chaos_bench(n_requests=3000, n_constraints=20, err=sys.stderr):
     }
 
 
+_EXTERNAL_REGO = """package externalbench
+
+violation[{"msg": msg}] {
+    images := [img | img := input.review.object.spec.containers[_].image]
+    response := external_data({"provider": "bench-provider", "keys": images})
+    count(response.errors) > 0
+    msg := sprintf("verification failed: %v", [response.errors])
+}
+"""
+
+
+class _StubProviderHTTP:
+    """Stdlib stub provider for the --external lane: answers the
+    ProviderRequest protocol, counts every outbound fetch (the
+    batching-contract number this bench reports), and marks keys
+    containing "bad" with an error entry."""
+
+    def __init__(self, latency_s=0.0):
+        import json as _json
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.fetches = 0
+        self.keys_fetched = 0
+        self.latency_s = latency_s
+        outer = self
+
+        class _H(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                body = _json.loads(self.rfile.read(n) or b"{}")
+                keys = ((body.get("request") or {}).get("keys")) or []
+                outer.fetches += 1
+                outer.keys_fetched += len(keys)
+                if outer.latency_s:
+                    time.sleep(outer.latency_s)
+                payload = _json.dumps({
+                    "response": {
+                        "items": [
+                            {"key": k, "error": "unsigned"}
+                            if "bad" in k
+                            else {"key": k, "value": f"ok:{k}"}
+                            for k in keys
+                        ],
+                        "systemError": "",
+                    }
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}/v"
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+
+
+def run_external_bench(n_requests=3000, n_keys=7, err=sys.stderr):
+    """The `--external` replay (docs/externaldata.md): admission load
+    whose policy consults an external-data provider through the batch
+    plane. Reports p50/p99, cache hit rate, and fetches-per-batch —
+    the numbers that prove lookups ride the micro-batch instead of
+    breaking it (steady state: hit rate -> 1.0, fetches/batch -> 0)."""
+    import threading
+
+    from gatekeeper_tpu.constraint import (
+        Backend,
+        K8sValidationTarget,
+        TpuDriver,
+    )
+    from gatekeeper_tpu.externaldata import ExternalDataSystem
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    stub = _StubProviderHTTP()
+    metrics = MetricsRegistry()
+    system = ExternalDataSystem(metrics=metrics)
+    system.upsert({
+        "apiVersion": "externaldata.gatekeeper.sh/v1alpha1",
+        "kind": "Provider",
+        "metadata": {"name": "bench-provider"},
+        "spec": {
+            "url": stub.url,
+            "timeout": 5,
+            "failurePolicy": "Ignore",
+            "cacheTTLSeconds": 3600,
+            "negativeCacheTTLSeconds": 3600,
+        },
+    })
+    client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    client.set_external_data(system)
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "externalbench"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "ExternalBench"}}},
+            "targets": [{"target": TARGET, "rego": _EXTERNAL_REGO}],
+        },
+    })
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "ExternalBench",
+        "metadata": {"name": "eb"},
+        "spec": {"match": {"kinds": [
+            {"apiGroups": [""], "kinds": ["Pod"]}
+        ]}},
+    })
+
+    def ext_request(i, violating=False):
+        r = make_request(i, violating=False)
+        key = f"bad.img/{i % n_keys}" if violating else f"reg.example/app{i % n_keys}"
+        r["object"]["spec"]["containers"][0]["image"] = key
+        return r
+
+    batcher = MicroBatcher(client, TARGET, window_ms=2.0, metrics=metrics)
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=30, metrics=metrics
+    )
+    out = []
+    batcher.start()
+    try:
+        _warm_route(client)
+
+        def run_phase(name, violating):
+            f0, b0 = system.fetch_count, batcher.batches_dispatched
+            snap0 = metrics.snapshot()["counters"]
+            lk = "externaldata_cache_lookups_total"
+            hits0 = sum(
+                v for k, v in snap0.items()
+                if k.startswith(lk) and 'result="hit"' in k
+            )
+            total0 = sum(
+                v for k, v in snap0.items() if k.startswith(lk)
+            )
+            r = replay(
+                handler,
+                [ext_request(i, violating) for i in range(max(400, n_requests // 3))],
+                128,
+            )
+            snap1 = metrics.snapshot()["counters"]
+            hits1 = sum(
+                v for k, v in snap1.items()
+                if k.startswith(lk) and 'result="hit"' in k
+            )
+            total1 = sum(
+                v for k, v in snap1.items() if k.startswith(lk)
+            )
+            batches = max(1, batcher.batches_dispatched - b0)
+            r.update(
+                phase=name,
+                fetches=system.fetch_count - f0,
+                fetches_per_batch=round(
+                    (system.fetch_count - f0) / batches, 3
+                ),
+                cache_hit_rate=round(
+                    (hits1 - hits0) / max(1, total1 - total0), 4
+                ),
+            )
+            out.append(r)
+            print(f"external phase: {r}", file=err)
+
+        run_phase("cold_allow", violating=False)
+        run_phase("warm_allow", violating=False)
+        run_phase("warm_deny", violating=True)
+    finally:
+        batcher.stop()
+        stub.stop()
+    return {
+        "keys": n_keys,
+        "provider_fetches": system.fetch_count,
+        "provider_keys_fetched": stub.keys_fetched,
+        "stale_serves": system.stale_serves,
+        "phases": out,
+    }
+
+
 # the reference harness's constraint-count ladder
 # (pkg/webhook/policy_benchmark_test.go:265-276)
 LADDER = (5, 10, 50, 100, 200, 1000, 2000)
@@ -812,6 +1000,11 @@ if __name__ == "__main__":
         n_req = int(pos[0]) if pos else 3_000
         n_con = int(pos[1]) if len(pos) > 1 else 20
         print(json.dumps(run_chaos_bench(n_req, n_con)))
+    elif "--external" in sys.argv:
+        pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n_req = int(pos[0]) if pos else 3_000
+        n_keys = int(pos[1]) if len(pos) > 1 else 7
+        print(json.dumps(run_external_bench(n_req, n_keys)))
     elif "--mutate" in sys.argv:
         pos = [a for a in sys.argv[1:] if not a.startswith("--")]
         n_req = int(pos[0]) if pos else 10_000

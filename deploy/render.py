@@ -216,6 +216,13 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
              "constrainttemplatepodstatuses", "Namespaced", ["v1beta1"]),
         _crd("status.gatekeeper.sh", "MutatorPodStatus",
              "mutatorpodstatuses", "Namespaced", ["v1beta1"]),
+        _crd("status.gatekeeper.sh", "ProviderPodStatus",
+             "providerpodstatuses", "Namespaced", ["v1beta1"]),
+        # external-data Providers (docs/externaldata.md): out-of-band
+        # lookup endpoints the external_data builtin resolves through,
+        # batched per micro-batch by the webhook pods
+        _crd("externaldata.gatekeeper.sh", "Provider", "providers",
+             "Cluster", ["v1alpha1"]),
         # the mutation CRDs (pkg/mutation in the reference; the TPU
         # build screens their Match specs with the same kernel as
         # constraints)
@@ -257,6 +264,7 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
                     "apiGroups": [
                         "config.gatekeeper.sh",
                         "constraints.gatekeeper.sh",
+                        "externaldata.gatekeeper.sh",
                         "mutations.gatekeeper.sh",
                         "templates.gatekeeper.sh",
                         "status.gatekeeper.sh",

@@ -121,6 +121,11 @@ class _Ctx:
 
     env: Dict[str, AVal] = field(default_factory=dict)
     rule: str = ""
+    # `:=`-assigned value terms by var name: lets the external_data
+    # audit resolve `keys: images` back to the comprehension that
+    # built `images` (extraction needs the defining expression)
+    defs: Dict[str, Any] = field(default_factory=dict)
+    rule_ast: Optional[A.Rule] = None
 
 
 class Analyzer:
@@ -143,6 +148,18 @@ class Analyzer:
         self._soft = 0
         self._analyzed_rules: Set[int] = set()
         self._seen_diags: Set[Tuple] = set()
+        # rule identity -> owning module: external_data call records
+        # carry the module so key extraction can evaluate their keys
+        # expression later (externaldata/extract.py)
+        self._rule_module: Dict[int, A.Module] = {}
+        for mod in self.modules:
+            for rule in mod.rules:
+                self._rule_module[id(rule)] = mod
+        # the := target whose value term is currently being evaluated
+        # (identity-matched): lets _eval_call know which var binds an
+        # external_data response
+        self._assign_target: Optional[str] = None
+        self._assign_value: Any = None
 
     # -- diagnostics --------------------------------------------------------
 
@@ -288,7 +305,7 @@ class Analyzer:
         if id(rule) in self._analyzed_rules:
             return
         self._analyzed_rules.add(id(rule))
-        ctx = _Ctx(rule=rule.head.name)
+        ctx = _Ctx(rule=rule.head.name, rule_ast=rule)
         for formal in rule.head.args or []:
             if isinstance(formal, A.Var):
                 ctx.env[formal.name] = OPAQUE
@@ -344,9 +361,16 @@ class Analyzer:
             return
 
     def _audit_assign(self, target: A.Term, value: A.Term, ctx: _Ctx):
-        val = self._eval_term(value, ctx)
+        prev_t, prev_v = self._assign_target, self._assign_value
+        if isinstance(target, A.Var):
+            self._assign_target, self._assign_value = target.name, value
+        try:
+            val = self._eval_term(value, ctx)
+        finally:
+            self._assign_target, self._assign_value = prev_t, prev_v
         if isinstance(target, A.Var):
             ctx.env[target.name] = val
+            ctx.defs[target.name] = value
             return
         if isinstance(target, A.Wildcard):
             return
@@ -450,7 +474,10 @@ class Analyzer:
         # compiler catches failures here and degrades to opaque
         self._soft += 1
         try:
-            sub = _Ctx(env=dict(ctx.env), rule=ctx.rule)
+            sub = _Ctx(
+                env=dict(ctx.env), rule=ctx.rule,
+                defs=dict(ctx.defs), rule_ast=ctx.rule_ast,
+            )
             for e in term.body:
                 self._audit_expr(e, sub)
             head = self._eval_term(term.head, sub)
@@ -670,6 +697,8 @@ class Analyzer:
     # -- calls --------------------------------------------------------------
 
     def _eval_call(self, call: A.Call, ctx: _Ctx) -> AVal:
+        if call.name == "external_data":
+            return self._eval_external_data(call, ctx)
         args = [self._eval_term(a, ctx) for a in call.args]
         name = call.name
         base = name.split(".")[-1] if "." in name else name
@@ -737,6 +766,269 @@ class Analyzer:
         )
         return OPAQUE
 
+
+    # -- external_data (GK-V009) --------------------------------------------
+
+    def _eval_external_data(self, call: A.Call, ctx: _Ctx) -> AVal:
+        """Record the call site and classify its batchability. The
+        template compiles as a screen either way (the compiler treats
+        the response as opaque); the classification decides how sharp
+        the batch plane can be: extractable keys prefetch in one fetch
+        per (provider, micro-batch), and an error-gated rule body lets
+        clean-cache-hit rows skip the interpreter entirely."""
+        from .report import ExternalDataCall
+
+        for a in call.args:  # arg values still walk (diagnose refs)
+            self._eval_term(a, ctx)
+        spec = ExternalDataCall(rule=ctx.rule, line=call.line)
+        detail = ""
+        arg = call.args[0] if len(call.args) == 1 else None
+        if not isinstance(arg, A.ObjectTerm):
+            detail = "argument must be a literal object"
+        else:
+            fields: Dict[str, A.Term] = {}
+            for k, v in arg.items:
+                if isinstance(k, A.Scalar) and isinstance(k.value, str):
+                    fields[k.value] = v
+            prov = fields.get("provider")
+            if isinstance(prov, A.Scalar) and isinstance(prov.value, str):
+                spec.provider = prov.value
+            else:
+                detail = (
+                    "provider must be a literal string (non-literal "
+                    "providers cannot batch-prefetch)"
+                )
+            keys = fields.get("keys")
+            resolved = self._resolve_keys_term(keys, ctx)
+            if resolved is not None and self._keys_input_only(
+                resolved, ctx, set()
+            ):
+                spec.keys_term = resolved
+                rule_ast = ctx.rule_ast
+                spec.module = (
+                    self._rule_module.get(id(rule_ast))
+                    if rule_ast is not None
+                    else None
+                )
+                spec.extractable = spec.provider is not None
+            elif not detail:
+                detail = (
+                    "keys expression is not input-derived; lookups "
+                    "cannot batch-prefetch (per-call fetch at resolve "
+                    "time)"
+                )
+        if (
+            call is self._assign_value
+            and self._assign_target is not None
+            and ctx.rule_ast is not None
+        ):
+            spec.respvar = self._assign_target
+            spec.error_gated = self._requires_errors(
+                ctx.rule_ast.body, self._assign_target
+            )
+        self.report.external_calls.append(spec)
+        msg = (
+            "external_data: lookups ride the micro-batch (one fetch "
+            "per provider per batch); compiles as a screen — "
+            + (
+                "clean cache-hit rows stay fused, cold-miss rows "
+                "re-check on the interpreter"
+                if spec.error_gated and spec.extractable
+                else "matching rows re-check on the interpreter"
+            )
+        )
+        if detail:
+            msg += f" ({detail})"
+        self._diag("GK-V009", msg, ctx.rule, call.line)
+        return INV
+
+    def _resolve_keys_term(
+        self, term: Optional[A.Term], ctx: _Ctx, depth: int = 0
+    ) -> Optional[A.Term]:
+        """Follow `keys: somevar` through := definitions (bounded)."""
+        if term is None or depth > 4:
+            return None if term is None else term
+        if isinstance(term, A.Var) and term.name in ctx.defs:
+            return self._resolve_keys_term(
+                ctx.defs[term.name], ctx, depth + 1
+            )
+        return term
+
+    def _keys_input_only(
+        self, term: A.Term, ctx: _Ctx, locals_: Set[str], depth: int = 0
+    ) -> bool:
+        """True when the keys expression depends only on input.review
+        (plus literals and its own local bindings) — the condition for
+        evaluating it standalone per review at prefetch time."""
+        if depth > 8:
+            return False
+        if isinstance(term, (A.Scalar, A.Wildcard)):
+            return True
+        if isinstance(term, A.Var):
+            if term.name in locals_:
+                return True
+            d = ctx.defs.get(term.name)
+            if d is not None:
+                return self._keys_input_only(d, ctx, locals_, depth + 1)
+            return False
+        if isinstance(term, (A.ArrayTerm, A.SetTerm)):
+            return all(
+                self._keys_input_only(t, ctx, locals_, depth + 1)
+                for t in term.items
+            )
+        if isinstance(term, A.BinOp):
+            return self._keys_input_only(
+                term.lhs, ctx, locals_, depth + 1
+            ) and self._keys_input_only(term.rhs, ctx, locals_, depth + 1)
+        if isinstance(term, A.Call):
+            # pure builtins over input-only args are fine (the
+            # extraction evaluates them); helper functions may read
+            # data/parameters, so they stay conservative
+            if term.name == "external_data" or term.name not in BUILTINS:
+                return False
+            return all(
+                self._keys_input_only(a, ctx, locals_, depth + 1)
+                for a in term.args
+            )
+        if isinstance(term, A.Ref):
+            if not isinstance(term.head, A.Var):
+                return False
+            h = term.head.name
+            if h == "input":
+                if not (
+                    term.ops
+                    and isinstance(term.ops[0], A.Scalar)
+                    and term.ops[0].value == "review"
+                ):
+                    return False
+            elif h not in locals_:
+                d = ctx.defs.get(h)
+                if d is None or not self._keys_input_only(
+                    d, ctx, locals_, depth + 1
+                ):
+                    return False
+            for op in term.ops:
+                if isinstance(op, (A.Scalar, A.Wildcard)):
+                    continue
+                if isinstance(op, A.Var):
+                    # an unbound var segment binds by iteration here
+                    locals_.add(op.name)
+                    continue
+                return False
+            return True
+        if isinstance(term, A.Comprehension):
+            sub = set(locals_)
+            for e in term.body:
+                if not self._comp_expr_input_only(e, ctx, sub, depth + 1):
+                    return False
+            if not self._keys_input_only(term.head, ctx, sub, depth + 1):
+                return False
+            return term.key is None or self._keys_input_only(
+                term.key, ctx, sub, depth + 1
+            )
+        return False
+
+    def _comp_expr_input_only(
+        self, e: A.Expr, ctx: _Ctx, locals_: Set[str], depth: int
+    ) -> bool:
+        if isinstance(e, A.SomeDecl):
+            locals_.update(e.names)
+            return True
+        if isinstance(e, A.Assign):
+            ok = self._keys_input_only(e.value, ctx, locals_, depth)
+            if isinstance(e.target, A.Var):
+                locals_.add(e.target.name)
+            elif isinstance(e.target, A.ArrayTerm):
+                for t in e.target.items:
+                    if isinstance(t, A.Var):
+                        locals_.add(t.name)
+            return ok
+        if isinstance(e, A.Unify):
+            for a, b in ((e.lhs, e.rhs), (e.rhs, e.lhs)):
+                if isinstance(a, A.Var) and self._keys_input_only(
+                    b, ctx, set(locals_), depth
+                ):
+                    self._keys_input_only(b, ctx, locals_, depth)
+                    locals_.add(a.name)
+                    return True
+            return self._keys_input_only(
+                e.lhs, ctx, locals_, depth
+            ) and self._keys_input_only(e.rhs, ctx, locals_, depth)
+        if isinstance(e, A.NotExpr):
+            return self._comp_expr_input_only(e.expr, ctx, locals_, depth)
+        if isinstance(e, A.TermExpr):
+            return self._keys_input_only(e.term, ctx, locals_, depth)
+        return False
+
+    # -- error-gated proof ---------------------------------------------------
+
+    def _requires_errors(self, body: List[A.Expr], respvar: str) -> bool:
+        """True when some positive top-level body expression requires
+        `respvar.errors` to be non-empty — then the rule can only fire
+        when the provider returned an error entry, so the fused screen
+        may soundly skip rows whose keys are all clean cache hits."""
+        for e in body:
+            terms: List[A.Term] = []
+            if isinstance(e, A.TermExpr):
+                terms = [e.term]
+            elif isinstance(e, A.Assign):
+                terms = [e.value]
+            elif isinstance(e, A.Unify):
+                terms = [e.lhs, e.rhs]
+            for t in terms:
+                if self._errors_requirement(t, respvar):
+                    return True
+        return False
+
+    def _is_errors_ref(self, t: A.Term, respvar: str) -> bool:
+        return (
+            isinstance(t, A.Ref)
+            and isinstance(t.head, A.Var)
+            and t.head.name == respvar
+            and bool(t.ops)
+            and isinstance(t.ops[0], A.Scalar)
+            and t.ops[0].value == "errors"
+        )
+
+    def _errors_requirement(self, t: A.Term, respvar: str) -> bool:
+        # `resp.errors[_]` / `resp.errors[i][...]`: each body solution
+        # demands an element, so firing implies errors is non-empty
+        if self._is_errors_ref(t, respvar) and len(t.ops) >= 2:
+            return True
+        if not isinstance(t, A.BinOp):
+            return False
+        flip = {
+            ">": "<", "<": ">", ">=": "<=", "<=": ">=",
+            "!=": "!=", "==": "==",
+        }
+        for a, b, op in (
+            (t.lhs, t.rhs, t.op),
+            (t.rhs, t.lhs, flip.get(t.op, t.op)),
+        ):
+            num = b.value if isinstance(b, A.Scalar) else None
+            if (
+                isinstance(a, A.Call)
+                and a.name == "count"
+                and len(a.args) == 1
+                and self._is_errors_ref(a.args[0], respvar)
+                and isinstance(num, (int, float))
+                and not isinstance(num, bool)
+            ):
+                if op == ">" and num >= 0:
+                    return True
+                if op in (">=", "==") and num >= 1:
+                    return True
+                if op == "!=" and num == 0:
+                    return True
+            if (
+                self._is_errors_ref(a, respvar)
+                and len(a.ops) == 1
+                and op == "!="
+                and isinstance(b, A.ArrayTerm)
+                and not b.items
+            ):
+                return True
+        return False
 
     # -- tableizability (mirrors symbolic._tableize_function's gates) -------
 

@@ -1,8 +1,10 @@
-"""Offline template + mutator linting CLI.
+"""Offline template + mutator + provider linting CLI.
 
     python -m gatekeeper_tpu.analysis deploy/ [more paths...]
         [--json] [--baseline FILE] [--write-baseline FILE] [--strict]
     python -m gatekeeper_tpu.analysis mutators deploy/ [more paths...]
+        [--json] [--baseline FILE] [--write-baseline FILE]
+    python -m gatekeeper_tpu.analysis providers deploy/ [more paths...]
         [--json] [--baseline FILE] [--write-baseline FILE]
 
 Default mode scans the given files/directories for ConstraintTemplate
@@ -16,6 +18,12 @@ reports location-path parse errors and cross-mutator schema conflicts
 with stable GK-M0xx codes (docs/mutation.md), and compares against a
 baseline manifest ({"mutators": {id: [codes]}}) so CI pins the shipped
 example mutators clean.
+
+`providers` mode scans for externaldata.gatekeeper.sh Provider
+documents and reports spec problems with stable GK-P0xx codes
+(docs/externaldata.md): unreachable URL schemes, missing timeouts,
+fail-open providers with no cache to fall back on. Baseline manifest:
+{"providers": {id: [codes]}}.
 
 Exit status:
   0  every template analyzed, no INVALID verdicts, no baseline
@@ -213,9 +221,125 @@ def run_mutators(argv: List[str]) -> int:
     return 1 if failures else 0
 
 
+def _iter_provider_docs(path: str):
+    import yaml
+
+    from ..externaldata import is_provider_doc
+
+    with open(path) as f:
+        try:
+            docs = list(yaml.safe_load_all(f))
+        except yaml.YAMLError as e:
+            raise SystemExit(f"error: {path}: YAML parse error: {e}")
+    for doc in docs:
+        if is_provider_doc(doc):
+            yield path, doc
+
+
+def collect_providers(paths: List[str]) -> List[Tuple[str, Dict[str, Any]]]:
+    out: List[Tuple[str, Dict[str, Any]]] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for fn in sorted(files):
+                    if fn.endswith((".yaml", ".yml")):
+                        out.extend(
+                            _iter_provider_docs(os.path.join(root, fn))
+                        )
+        elif p.endswith((".yaml", ".yml")):
+            out.extend(_iter_provider_docs(p))
+        else:
+            raise SystemExit(f"error: unsupported path {p!r}")
+    return out
+
+
+def run_providers(argv: List[str]) -> int:
+    """`providers` mode: GK-P0xx lint + baseline enforcement
+    (mirrors the `mutators` mode contract)."""
+    from ..externaldata.lint import lint_providers
+
+    ap = argparse.ArgumentParser(
+        prog="python -m gatekeeper_tpu.analysis providers",
+        description=(
+            "Offline external-data Provider linter (spec + failure "
+            "posture)"
+        ),
+    )
+    ap.add_argument("paths", nargs="+", help="provider YAML files or dirs")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--baseline", help="code manifest to compare against")
+    ap.add_argument(
+        "--write-baseline", help="write the current codes to FILE"
+    )
+    args = ap.parse_args(argv)
+
+    entries = collect_providers(args.paths)
+    if not entries:
+        print("no Providers found", file=sys.stderr)
+        return 2
+
+    lints = lint_providers(entries)
+
+    failures: List[str] = []
+    if args.baseline:
+        with open(args.baseline) as f:
+            baseline = (json.load(f) or {}).get("providers", {})
+        for lint in lints:
+            want = baseline.get(lint.id)
+            if want is None:
+                continue  # new provider: allowed
+            new_codes = sorted(set(lint.codes) - set(want))
+            if new_codes:
+                failures.append(
+                    f"{lint.id}: new diagnostics vs baseline: "
+                    f"{', '.join(new_codes)}"
+                )
+    else:
+        for lint in lints:
+            if not lint.ok:
+                failures.append(lint.render())
+
+    if args.write_baseline:
+        manifest = {
+            "providers": {
+                lint.id: sorted(lint.codes) for lint in lints
+            }
+        }
+        with open(args.write_baseline, "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "providers": [lint.to_dict() for lint in lints],
+                    "failures": failures,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for lint in lints:
+            print(f"[{lint.source}] {lint.render()}")
+        if failures:
+            print("\nFAIL:", file=sys.stderr)
+            for f_ in failures:
+                print(f"  {f_}", file=sys.stderr)
+        else:
+            n_ok = sum(1 for lint in lints if lint.ok)
+            print(
+                f"\nOK: {len(lints)} provider(s): clean={n_ok} "
+                f"flagged={len(lints) - n_ok}"
+            )
+    return 1 if failures else 0
+
+
 def run(argv: List[str]) -> int:
     if argv and argv[0] == "mutators":
         return run_mutators(argv[1:])
+    if argv and argv[0] == "providers":
+        return run_providers(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m gatekeeper_tpu.analysis",
         description="Static vectorizability linter for ConstraintTemplates",

@@ -66,6 +66,11 @@ CODES: Dict[str, Tuple[str, str]] = {
     "GK-V006": ("inventory-dependent", PARTIAL_ROWS),
     "GK-V007": ("unsupported-construct", INTERPRETER),
     "GK-V008": ("invalid-entrypoint", INVALID),
+    # external_data(provider, keys): compiles as a screen whose per-row
+    # bits come from the batch-prefetched response cache — fully
+    # cache-hit rows stay fused, cold-miss/error rows re-check on the
+    # interpreter (docs/externaldata.md)
+    "GK-V009": ("external-data", PARTIAL_ROWS),
 }
 
 # compiler-disagreement sentinel: the analyzer predicted compilable but
@@ -109,12 +114,45 @@ class Diagnostic:
 
 
 @dataclass
+class ExternalDataCall:
+    """One recorded external_data call site (GK-V009). Drives the batch
+    plane: `extractable` calls (literal provider + input-derived keys
+    expression) prefetch per micro-batch; `error_gated` calls (the rule
+    body provably requires a non-empty response.errors) additionally
+    let the fused screen skip rows whose keys are all clean cache hits.
+
+    `keys_term`/`module` are live AST handles for the extraction
+    micro-evaluation (externaldata/extract.py) — deliberately excluded
+    from to_dict()."""
+
+    provider: Optional[str] = None
+    rule: str = ""
+    line: int = 0
+    extractable: bool = False
+    error_gated: bool = False
+    respvar: Optional[str] = None
+    keys_term: Any = None
+    module: Any = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "provider": self.provider,
+            "rule": self.rule,
+            "line": self.line,
+            "extractable": self.extractable,
+            "error_gated": self.error_gated,
+        }
+
+
+@dataclass
 class VectorizabilityReport:
     """Per-template analysis outcome (one report per constraint kind)."""
 
     kind: str
     verdict: str = VECTORIZED
     diagnostics: List[Diagnostic] = field(default_factory=list)
+    # external_data call sites (GK-V009); empty for ordinary templates
+    external_calls: List[ExternalDataCall] = field(default_factory=list)
 
     def add(
         self,
@@ -140,6 +178,33 @@ class VectorizabilityReport:
     def codes(self) -> List[str]:
         return sorted({d.code for d in self.diagnostics})
 
+    def extdata_mode(self) -> Optional[str]:
+        """The fused-screen mode for the template's external calls:
+        None  — no external_data calls, or some call is unextractable
+                (no prefetch possible; coarse all-rows screen);
+        "all" — every call is extractable: the batch plane prefetches,
+                but the screen routes every matching row (a violation
+                may fire on response *values*, so key cleanliness
+                proves nothing);
+        "err" — extractable AND every call is provably error-gated:
+                rows whose keys are all clean cache hits can never
+                violate through the external path, so the screen skips
+                them — the fully-cache-hit batch stays fused."""
+        if not self.external_calls:
+            return None
+        if not all(
+            c.extractable and c.provider for c in self.external_calls
+        ):
+            return None
+        if all(c.error_gated for c in self.external_calls):
+            return "err"
+        return "all"
+
+    def external_providers(self) -> List[str]:
+        return sorted(
+            {c.provider for c in self.external_calls if c.provider}
+        )
+
     def primary_code(self) -> Optional[str]:
         """The diagnostic code that set the verdict (worst cap, first
         occurrence) — the machine-readable 'why' for routing metrics."""
@@ -153,12 +218,19 @@ class VectorizabilityReport:
         return worst.code if worst is not None else None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "kind": self.kind,
             "verdict": self.verdict,
             "codes": self.codes,
             "diagnostics": [d.to_dict() for d in self.diagnostics],
         }
+        if self.external_calls:
+            out["external_data"] = {
+                "mode": self.extdata_mode(),
+                "providers": self.external_providers(),
+                "calls": [c.to_dict() for c in self.external_calls],
+            }
+        return out
 
     def render(self) -> str:
         lines = [f"{self.kind}: {self.verdict}"]

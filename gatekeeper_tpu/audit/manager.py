@@ -197,7 +197,11 @@ class AuditManager:
     def _audit_once(self, t0, timestamp, log, root) -> AuditReport:
         from ..obs import start_span
 
-        t_disp0 = time.time()
+        # wall stamps only label the report/spans; all phase DURATION
+        # math below runs on perf_counter marks (time.time steps under
+        # NTP, and a sweep is long enough to straddle a step)
+        wall_disp0 = time.time()
+        perf_disp0 = time.perf_counter()
         with start_span(self.tracer, "dispatch", parent=root) as dsp:
             if self.audit_from_cache or self.cluster is None:
                 log.info("Auditing from cache")
@@ -220,7 +224,8 @@ class AuditManager:
                         if k in stats
                     }
                 )
-        t_agg0 = time.time()
+        perf_agg0 = time.perf_counter()
+        wall_agg0 = wall_disp0 + (perf_agg0 - perf_disp0)
         statuses: Dict[str, ConstraintStatus] = {}
         totals_by_ea: Dict[str, int] = {}
         for r in results:
@@ -306,7 +311,8 @@ class AuditManager:
                 constraint_status="enforced",
                 constraint_violations=str(st.total_violations),
             )
-        t_pub0 = time.time()
+        perf_pub0 = time.perf_counter()
+        wall_pub0 = wall_disp0 + (perf_pub0 - perf_disp0)
         try:
             # named fault point (docs/robustness.md): a K8s status-write
             # error — the reference's retry-with-backoff surface
@@ -326,26 +332,27 @@ class AuditManager:
             )
             if root is not None:
                 root.set_attr(status_write_error=str(e))
-        t_pub1 = time.time()
+        perf_pub1 = time.perf_counter()
+        wall_pub1 = wall_disp0 + (perf_pub1 - perf_disp0)
         if self.tracer is not None:
             # aggregate/status_write stamped from timing marks instead
             # of open spans: an exception mid-aggregation must not leave
             # a dangling open span pinning the sweep trace
             self.tracer.record_span(
-                "aggregate", t_agg0, t_pub0, parent=root,
+                "aggregate", wall_agg0, wall_pub0, parent=root,
                 violations=len(results),
             )
             self.tracer.record_span(
-                "status_write", t_pub0, t_pub1, parent=root,
+                "status_write", wall_pub0, wall_pub1, parent=root,
                 statuses=len(statuses),
             )
         self.last_run_seconds = t0
         self.audit_duration_seconds = duration
         if self.metrics is not None:
             for phase, dt in (
-                ("dispatch", t_agg0 - t_disp0),
-                ("aggregate", t_pub0 - t_agg0),
-                ("status_write", t_pub1 - t_pub0),
+                ("dispatch", perf_agg0 - perf_disp0),
+                ("aggregate", perf_pub0 - perf_agg0),
+                ("status_write", perf_pub1 - perf_pub0),
             ):
                 self.metrics.observe(
                     "audit_phase_seconds", dt, phase=phase
@@ -407,7 +414,7 @@ class AuditManager:
             # unpageable aggregated API) must not abort the whole sweep
             # — the reference logs and moves to the next kind
             # (manager.go:277-298's error branches)
-            t_kind = time.time()
+            wall_kind, perf_kind = time.time(), time.perf_counter()
             try:
                 kind_results = self._review_pages(pages, ns_cache, ns_gvk)
             except Exception as e:
@@ -417,8 +424,8 @@ class AuditManager:
                     gvk=str(gvk),
                 )
                 if self.tracer is not None:
-                    self.tracer.record_span(
-                        "list_and_review", t_kind, time.time(),
+                    self.tracer.record_window(
+                        "list_and_review", wall_kind, perf_kind,
                         parent=self.tracer.current(), status="error",
                         gvk=str(gvk), error=str(e),
                     )
@@ -426,8 +433,8 @@ class AuditManager:
             if self.tracer is not None:
                 # one span per kind under the sweep's dispatch span
                 # (direct mode's list/chunk/review phase)
-                self.tracer.record_span(
-                    "list_and_review", t_kind, time.time(),
+                self.tracer.record_window(
+                    "list_and_review", wall_kind, perf_kind,
                     parent=self.tracer.current(),
                     gvk=str(gvk), results=len(kind_results),
                 )

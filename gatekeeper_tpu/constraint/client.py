@@ -85,7 +85,27 @@ class Client:
         # template name -> entry; (group, kind) -> {subpath: constraint}
         self._templates: Dict[str, _TemplateEntry] = {}
         self._constraints: Dict[Tuple[str, str], Dict[str, dict]] = {}
+        # externaldata.ExternalDataSystem (set_external_data): the batch
+        # plane external_data lookups resolve through
+        self.external_data = None
         self._driver.init()
+
+    def set_external_data(self, system) -> None:
+        """Wire the external-data system through the whole evaluation
+        stack: the client (batch epochs), the driver (prefetch + the
+        extdata row-feature screen), and the interpreter builtin's
+        process binding."""
+        from ..externaldata import set_system
+
+        self.external_data = system
+        hook = getattr(self._driver, "set_external_data", None)
+        if hook is not None:
+            hook(system)
+        set_system(system)
+
+    def _extdata_begin(self) -> None:
+        if self.external_data is not None:
+            self.external_data.begin_batch()
 
     # -- template pipeline (client.go:240-470) ------------------------------
 
@@ -314,6 +334,7 @@ class Client:
     # -- review / audit (client.go:764-836) ---------------------------------
 
     def review(self, obj: Any, tracing: bool = False) -> Responses:
+        self._extdata_begin()
         responses = Responses()
         for name, handler in self.targets.items():
             handled, review = handler.handle_review(obj)
@@ -335,6 +356,7 @@ class Client:
         micro-batching webhook's entry point; the reference client has no
         equivalent — its webhook evaluates one request per goroutine,
         pkg/webhook/policy.go:141)."""
+        self._extdata_begin()
         out: List[Responses] = [Responses() for _ in objs]
         for name, handler in self.targets.items():
             idxs: List[int] = []
@@ -347,6 +369,14 @@ class Client:
                 inputs.append({"review": review})
             if not inputs:
                 continue
+            if self.external_data is not None:
+                # batch plane: one deduped prefetch per target BEFORE
+                # dispatch, whichever engine (and whichever rung) will
+                # evaluate — repeat keys across the batch then answer
+                # from the response cache
+                self._prefetch_external_for(
+                    [i["review"] for i in inputs]
+                )
             resps = self._driver.query_many(
                 f'hooks["{name}"].violation', inputs, tracing
             )
@@ -356,6 +386,63 @@ class Client:
                 resp.target = name
                 out[i].by_target[name] = resp
         return out
+
+    def prefetch_external(self, objs: Sequence[Any]) -> None:
+        """Batch-plane external-data prefetch for a review batch that
+        will evaluate per-request (the host-interpreter rung): opens a
+        fetch epoch and dedupes/fetches the batch's keys once per
+        provider, so the per-request evaluations that follow serve from
+        the response cache. Best-effort; no-op without a wired
+        system."""
+        if self.external_data is None:
+            return
+        self.external_data.begin_batch()
+        for name, handler in self.targets.items():
+            reviews = []
+            for obj in objs:
+                handled, review = handler.handle_review(obj)
+                if handled:
+                    reviews.append(review)
+            if reviews:
+                self._prefetch_external_for(reviews)
+
+    def _prefetch_external_for(self, reviews: Sequence[Any]) -> None:
+        """Engine-agnostic batch prefetch: extract + dedupe the batch's
+        external-data keys from the ingested templates' recorded call
+        sites, then at most one outbound fetch per provider. Works for
+        any driver exposing the interpreter (the TPU driver ALSO
+        prefetches on its own dispatch path — idempotent, the second
+        pass finds no misses)."""
+        system = self.external_data
+        interp = getattr(self._driver, "interp", None)
+        if system is None or interp is None:
+            return
+        try:
+            from ..externaldata.extract import batch_wants
+
+            with self._lock:
+                entries = list(self._templates.values())
+            wants_total: Dict[str, set] = {}
+            # extraction evaluates against the driver-mounted modules:
+            # hold the driver's mutation mutex (reads race module churn
+            # otherwise), but NEVER during the outbound fetch below
+            mutex = self._driver._mutex if hasattr(
+                self._driver, "_mutex"
+            ) else threading.RLock()
+            with mutex:
+                for e in entries:
+                    rep = getattr(e.template, "vectorizability", None)
+                    calls = getattr(rep, "external_calls", None)
+                    if not calls:
+                        continue
+                    w = batch_wants(interp, calls, reviews)
+                    if w:
+                        for p, ks in w.items():
+                            wants_total.setdefault(p, set()).update(ks)
+            if wants_total:
+                system.prefetch(wants_total)
+        except Exception:
+            pass
 
     def review_host(self, obj: Any) -> Responses:
         """Host-interpreter review: the degraded rung of the admission
@@ -398,6 +485,7 @@ class Client:
         return ok
 
     def audit(self, tracing: bool = False) -> Responses:
+        self._extdata_begin()
         responses = Responses()
         for name, handler in self.targets.items():
             resp = self._driver.query(f'hooks["{name}"].audit', None, tracing)

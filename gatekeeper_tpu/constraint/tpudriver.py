@@ -163,6 +163,11 @@ class _Corpus:
     ov_member: Optional[np.ndarray] = None  # [B_pad, P] bool
     ov_capture: Optional[np.ndarray] = None  # [B_pad, P] int32
     ov_tabs: Optional[Dict[str, np.ndarray]] = None  # name -> [B_pad]
+    # external-data key extraction cache (feature name -> per-row
+    # {provider -> keys} | None): keys are corpus-constant, but the
+    # BITS derived from them track the live response cache and are
+    # recomputed per dispatch (_extdata_row_bits)
+    ext_keys: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -256,6 +261,10 @@ class TpuDriver(RegoDriver):
         self._prune_indexes: Dict[Tuple, Tuple[int, Any]] = {}
         self._prune_oracles: Dict[Tuple, Any] = {}
         self._hot_redispatches = 0  # chunks rerun for compaction overflow
+        # externaldata.ExternalDataSystem (set_external_data): the
+        # batch plane for external_data lookups — key prefetch per
+        # micro-batch + the extdata row-feature screen
+        self.external_data = None
 
     # -- module/data bookkeeping (cache invalidation) ------------------------
 
@@ -349,6 +358,12 @@ class TpuDriver(RegoDriver):
 
         return oracle_fn
 
+    def set_external_data(self, system) -> None:
+        """Wire the process's ExternalDataSystem (Runner/tests): the
+        driver prefetches each batch's deduped keys through it and
+        fills the extdata row-feature screens from its cache."""
+        self.external_data = system
+
     def set_metrics(self, metrics) -> None:
         """Late metrics wiring (Runner builds its registry after the
         driver); also re-exports verdicts already analyzed."""
@@ -425,6 +440,13 @@ class TpuDriver(RegoDriver):
             self._note_fallback(kind, code)
             self._programs[key] = None
             return None
+        extdata_feature = None
+        if report is not None:
+            mode = getattr(report, "extdata_mode", lambda: None)()
+            if mode is not None:
+                # feature encoding consumed by _extdata_row_bits:
+                # extdata:<kind>:<err|all>
+                extdata_feature = f"extdata:{kind}:{mode}"
         env = CompilerEnv(
             self.vocab,
             self.patterns,
@@ -433,6 +455,7 @@ class TpuDriver(RegoDriver):
             oracle_ns=f"{kind}|{key[2]}",
             oracle_ns_shared=f"{target}|{kind}",
             template_kind=kind,
+            extdata_feature=extdata_feature,
         )
         try:
             prog = compile_program(env, mods, params)
@@ -731,7 +754,10 @@ class TpuDriver(RegoDriver):
         )
         if needed:
             feats = self._row_feature_bits(target, corpus, needed)
-            self.kernel.stage_row_feats(stacked, feats)
+            self.kernel.stage_row_feats(
+                stacked, feats,
+                volatile=[n for n in needed if n.startswith("extdata:")],
+            )
         # named fault point (docs/robustness.md): "error" simulates a
         # failing device dispatch, "hang" a stalled one — exercised by
         # the chaos suite to drive the real degradation ladder
@@ -798,6 +824,12 @@ class TpuDriver(RegoDriver):
             ones = np.ones(len(corpus.reviews), bool)
             return {name: ones for name in names}
         for name in names:
+            if name.startswith("extdata:"):
+                # never cached in row_feats: the bits track the LIVE
+                # response cache (TTL expiry between sweeps must route
+                # rows back to the interpreter re-check)
+                out[name] = self._extdata_row_bits(target, corpus, name)
+                continue
             cached = corpus.row_feats.get(name)
             if cached is not None:
                 out[name] = cached
@@ -891,6 +923,114 @@ class TpuDriver(RegoDriver):
         corpus.value_counts[pid] = result
         return result
 
+    # -- external data (docs/externaldata.md) --------------------------------
+
+    def _extdata_row_bits(
+        self, target: str, corpus: _Corpus, name: str
+    ) -> np.ndarray:
+        """Per-row screen bits for an "extdata:<kind>:<mode>" feature.
+
+        Key extraction (analyzer-recorded input-derived keys
+        expressions, evaluated per review) is cached on the corpus; the
+        batch's deduped union feeds ONE system.prefetch per dispatch —
+        that call IS the one-outbound-fetch-per-(provider, batch)
+        contract for the fused path. Bits:
+          * mode "err" (provably error-gated templates): True iff some
+            key of the row is NOT a clean cache hit — clean rows can
+            never produce an error entry, so they stay fused;
+          * mode "all": all-ones (the feature exists to drive
+            prefetch; violations may depend on response values, so
+            every matching row re-checks).
+        """
+        n = len(corpus.reviews)
+        ones = np.ones(n, bool)
+        # warm_review_path seeds coarse all-ones bits: the warmup batch
+        # only needs the right SHAPES, and its synthetic reviews must
+        # never leak warmup keys into a real provider fetch
+        if corpus.row_feats and name in corpus.row_feats:
+            return corpus.row_feats[name]
+        system = self.external_data
+        parts = name.split(":")
+        kind = parts[1] if len(parts) > 1 else ""
+        mode = parts[2] if len(parts) > 2 else "all"
+        if system is None or not kind:
+            return ones
+        report = self.template_report(target, kind)
+        calls = getattr(report, "external_calls", None) if report else None
+        if not calls:
+            return ones
+        if corpus.ext_keys is None:
+            corpus.ext_keys = {}
+        per_row = corpus.ext_keys.get(name)
+        if per_row is None:
+            from ..externaldata.extract import extract_keys
+
+            per_row = []
+            for review in corpus.reviews:
+                wants: Optional[Dict[str, set]] = {}
+                for call in calls:
+                    if not call.extractable or not call.provider:
+                        wants = None
+                        break
+                    keys = extract_keys(self.interp, call, review)
+                    if keys is None:
+                        wants = None
+                        break
+                    wants.setdefault(call.provider, set()).update(keys)
+                per_row.append(wants)
+            corpus.ext_keys[name] = per_row
+        union: Dict[str, set] = {}
+        for wants in per_row:
+            if wants:
+                for p, ks in wants.items():
+                    union.setdefault(p, set()).update(ks)
+        if union:
+            system.prefetch(union)
+        if mode != "err":
+            return ones
+        bits = np.zeros(n, bool)
+        for i, wants in enumerate(per_row):
+            if wants is None:
+                bits[i] = True  # unextractable row: route it (sound)
+                continue
+            for p, ks in wants.items():
+                if any(not system.probe_clean(p, k) for k in ks):
+                    bits[i] = True
+                    break
+        bits |= np.asarray(corpus.row_fallback, bool)
+        return bits
+
+    def _prefetch_external(self, target: str, reviews: Sequence[Any]):
+        """Batch-plane prefetch for every external-data template in the
+        constraint set: extract + dedupe the batch's keys, then at most
+        one outbound fetch per provider. Best-effort — resolution
+        answers failures per the provider's failurePolicy."""
+        system = self.external_data
+        if system is None:
+            return
+        try:
+            from ..externaldata.extract import batch_wants
+
+            wants_total: Dict[str, set] = {}
+            with self._mutex:
+                for (t, kind) in list(self._kind_modules):
+                    if t != target:
+                        continue
+                    rep = self.template_report(t, kind)
+                    calls = getattr(rep, "external_calls", None)
+                    if not calls:
+                        continue
+                    w = batch_wants(self.interp, calls, reviews)
+                    if w:
+                        for p, ks in w.items():
+                            wants_total.setdefault(p, set()).update(ks)
+            if wants_total:
+                # OUTSIDE the serving mutex: a slow provider must stall
+                # only this batch, never the whole admission plane
+                system.prefetch(wants_total)
+        except Exception:
+            pass
+
     def _redispatch_chunk(self, policy, corpus: _Corpus, stacked, ci: int,
                           n_hot: int, require_compiled: bool = False):
         """Overflow path: one chunk had more violating rows than the
@@ -924,7 +1064,7 @@ class TpuDriver(RegoDriver):
                 return out
             r_cap = min(2 * r_cap, stacked.chunk)
 
-    def _need_pairs_np(self, cs, corpus, ns_cache, n):
+    def _need_pairs_np(self, target, cs, corpus, ns_cache, n):
         """Numpy path (use_jax=False): same pair semantics, eager host
         eval — used by tests that pin device/host equivalence."""
         fire("driver.device_dispatch")
@@ -939,9 +1079,18 @@ class TpuDriver(RegoDriver):
         viol = np.zeros((len(cs.constraints), n), bool)
         if compiled:
             overlay = _corpus_overlay(corpus)
+            needed = sorted(
+                {f for p in compiled for f in p.row_features}
+            )
+            row = (
+                self._row_feature_bits(target, corpus, needed)
+                if needed
+                else None
+            )
             counts = np.stack(
                 [self.evaluator.eval_np(
-                    p, corpus.tok, g=(corpus.g, corpus.g1), overlay=overlay)
+                    p, corpus.tok, g=(corpus.g, corpus.g1), overlay=overlay,
+                    row=row)
                  for p in compiled],
                 axis=0,
             )
@@ -991,6 +1140,16 @@ class TpuDriver(RegoDriver):
         ):
             return super().query_many(path, inputs, tracing)
         target = m.group(1)
+        if self.external_data is not None:
+            # batch plane: open a fresh fetch epoch and prefetch the
+            # batch's deduped keys (one outbound fetch per provider)
+            # BEFORE routing — both the fused screen and the
+            # interpreter rung then serve from the response cache
+            self.external_data.begin_batch()
+            self._prefetch_external(
+                target,
+                [M.hook_get_default(i or {}, "review", {}) for i in inputs],
+            )
         cold = len(inputs) >= MIN_DEVICE_BATCH and not self.review_path_warm(
             target
         )
@@ -1146,7 +1305,12 @@ class TpuDriver(RegoDriver):
             if self._constraint_gen == gen:
                 self._review_warm[target] = gen
                 warmed = True
-        if warmed and needed:
+        # extdata bits are volatile (they track the live response
+        # cache) and extraction on warmup reviews would leak synthetic
+        # warmup keys into a real provider fetch — only the
+        # corpus-constant invdup bits are worth precomputing here
+        precompute = [n for n in needed if not n.startswith("extdata:")]
+        if warmed and precompute:
             # pay the one-time audit-corpus encode + true feature bits
             # HERE (background thread) rather than inline in the first
             # real device batch; admission briefly queues behind this
@@ -1157,7 +1321,7 @@ class TpuDriver(RegoDriver):
                     real = self._ephemeral_corpus(
                         target, cs, reviews[:1], self._ns_cache(target)
                     )
-                    self._row_feature_bits(target, real, needed)
+                    self._row_feature_bits(target, real, precompute)
             except Exception:
                 pass
         return warmed
@@ -1222,6 +1386,11 @@ class TpuDriver(RegoDriver):
         if corpus is None:
             self.stats = {}
             return []
+        if self.external_data is not None:
+            # each sweep is one batch epoch: the corpus's deduped keys
+            # fetch once; flagged rows then render from the cache
+            self.external_data.begin_batch()
+            self._prefetch_external(target, corpus.reviews)
         return self._eval_reviews(
             target, corpus.reviews, trace, corpus=corpus
         )
@@ -1275,7 +1444,7 @@ class TpuDriver(RegoDriver):
                 )
             else:
                 pairs, stat_c, stat_i = self._need_pairs_np(
-                    cs, corpus, ns_cache, n_count
+                    target, cs, corpus, ns_cache, n_count
                 )
             t_dispatched = _time.perf_counter()
             # only the sparse pair set needing interpreter work is

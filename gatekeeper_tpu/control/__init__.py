@@ -28,6 +28,8 @@ from .controllers import (  # noqa: F401
     ControllerSwitch,
     MUTATOR_GVKS,
     MutatorController,
+    PROVIDER_GVK,
+    ProviderController,
     SyncController,
     TemplateController,
     TEMPLATE_GVK,

@@ -58,6 +58,9 @@ MUTATOR_GVKS = tuple(
     for kind in ("Assign", "AssignMetadata", "ModifySet")
 )
 
+EXTERNALDATA_GROUP = "externaldata.gatekeeper.sh"
+PROVIDER_GVK = GVK(EXTERNALDATA_GROUP, "v1alpha1", "Provider")
+
 
 def constraint_gvk(kind: str) -> GVK:
     return GVK(CONSTRAINT_GROUP, "v1beta1", kind)
@@ -400,6 +403,76 @@ class MutatorController:
         self.system.report_gauges()
 
 
+class ProviderController:
+    """externaldata.gatekeeper.sh/v1alpha1 Provider ingestion: one sink
+    feeding the ExternalDataSystem's registry. Invalid specs surface as
+    ProviderPodStatus errors and metrics, never as webhook failures —
+    an unregistered provider resolves undefined at evaluation time, and
+    a registered one degrades per its failurePolicy."""
+
+    def __init__(
+        self,
+        system,
+        switch: Optional[ControllerSwitch] = None,
+        metrics=None,
+        status=None,
+        logger=None,
+    ):
+        from ..logs import null_logger
+
+        self.system = system
+        self.switch = switch
+        self.metrics = metrics
+        self.status = status
+        self.log = logger if logger is not None else null_logger()
+        self.errors: Dict[str, str] = {}  # provider name -> last error
+
+    def sink(self, ev: Event) -> None:
+        if self.switch is not None and not self.switch.enter():
+            return
+        name = (ev.obj.get("metadata") or {}).get("name", "")
+        status = "active"
+        t0 = time.perf_counter()
+        try:
+            if ev.type == DELETED:
+                self.system.remove(name)
+                self.errors.pop(name, None)
+                if self.status is not None:
+                    self.status.delete_provider(name)
+            else:
+                self.system.upsert(ev.obj)
+                self.errors.pop(name, None)
+        except Exception as e:
+            status = "error"
+            self.errors[name] = str(e)
+            self.log.error(
+                "provider ingest failed",
+                err=e,
+                process="controller",
+                provider_name=name,
+            )
+        if ev.type != DELETED and self.status is not None:
+            provider = self.system.get(name)
+            self.status.publish_provider(
+                name,
+                status,
+                self.errors.get(name),
+                failure_policy=(
+                    provider.failure_policy if provider is not None else None
+                ),
+            )
+        if self.metrics is not None:
+            self.metrics.record(
+                "provider_ingestion_count", 1, status=status
+            )
+            self.metrics.observe(
+                "provider_ingestion_duration_seconds",
+                time.perf_counter() - t0,
+                status=status,
+            )
+        self.system.report_gauges()
+
+
 class SyncController:
     def __init__(
         self,
@@ -486,6 +559,11 @@ class ConfigController:
         # replayData motion the sync plane gets)
         mutation_system=None,
         mutation_registrar: Optional[Registrar] = None,
+        # external-data wipe/replay partners: same motion for the
+        # provider registry (and its response cache — a Config change
+        # must not leave answers from a retired provider set serving)
+        external_data_system=None,
+        provider_registrar: Optional[Registrar] = None,
     ):
         self.client = client
         self.sync_registrar = sync_registrar
@@ -497,6 +575,8 @@ class ConfigController:
         self.trace_config = trace_config
         self.mutation_system = mutation_system
         self.mutation_registrar = mutation_registrar
+        self.external_data_system = external_data_system
+        self.provider_registrar = provider_registrar
 
     def sink(self, ev: Event) -> None:
         if self.switch is not None and not self.switch.enter():
@@ -552,6 +632,15 @@ class ConfigController:
             if self.mutation_registrar is not None:
                 self.mutation_registrar.replace_watch(set())
                 self.mutation_registrar.replace_watch(set(MUTATOR_GVKS))
+
+        # 6. external-data wipe/replay: the provider registry (and its
+        # response cache) rebuilds from the cluster the same way — the
+        # bounced watch's initial List re-upserts every Provider CR
+        if self.external_data_system is not None:
+            self.external_data_system.wipe()
+            if self.provider_registrar is not None:
+                self.provider_registrar.replace_watch(set())
+                self.provider_registrar.replace_watch({PROVIDER_GVK})
 
         if self.tracker is not None:
             self.tracker.config.observe((CONFIG_NAMESPACE, CONFIG_NAME))

@@ -30,6 +30,8 @@ from .controllers import (
     ControllerSwitch,
     MUTATOR_GVKS,
     MutatorController,
+    PROVIDER_GVK,
+    ProviderController,
     SyncController,
     TemplateController,
     TEMPLATE_GVK,
@@ -228,6 +230,28 @@ class Runner:
         self._mutator_registrar = self.watch_mgr.new_registrar(
             "mutator-controller", self.mutator_controller.sink
         )
+        # external-data plane: the system is always built (cheap with no
+        # providers); the Provider controller keeps its registry synced,
+        # the client/driver prefetch through it, and the interpreter's
+        # external_data builtin resolves via the process binding
+        from ..externaldata import ExternalDataSystem
+
+        self.external_data = ExternalDataSystem(
+            metrics=metrics, tracer=self.tracer, logger=self.log
+        )
+        set_ed = getattr(client, "set_external_data", None)
+        if set_ed is not None:
+            set_ed(self.external_data)
+        self.provider_controller = ProviderController(
+            self.external_data,
+            switch=self.switch,
+            metrics=metrics,
+            status=self.status_writer,
+            logger=self.log,
+        )
+        self._provider_registrar = self.watch_mgr.new_registrar(
+            "provider-controller", self.provider_controller.sink
+        )
         self.config_controller = ConfigController(
             client,
             self._sync_registrar,
@@ -239,6 +263,8 @@ class Runner:
             trace_config=self.trace_config,
             mutation_system=self.mutation_system,
             mutation_registrar=self._mutator_registrar,
+            external_data_system=self.external_data,
+            provider_registrar=self._provider_registrar,
         )
         self._config_registrar = self.watch_mgr.new_registrar(
             "config-controller", self.config_controller.sink
@@ -324,6 +350,7 @@ class Runner:
         self._config_registrar.add_watch(CONFIG_GVK)
         for gvk in MUTATOR_GVKS:
             self._mutator_registrar.add_watch(gvk)
+        self._provider_registrar.add_watch(PROVIDER_GVK)
         if OPERATION_STATUS in self.operations:
             self._status_registrar.add_watch(TEMPLATE_STATUS_GVK)
             self._status_registrar.add_watch(CONSTRAINT_STATUS_GVK)
@@ -658,6 +685,13 @@ class Runner:
                                 ),
                             }
                         stats["webhook"] = wh
+                    if runner.external_data is not None:
+                        # provider health: per-provider breaker state +
+                        # failurePolicy answers "which lookups are
+                        # degraded right now" (docs/externaldata.md)
+                        stats["externaldata"] = (
+                            runner.external_data.snapshot()
+                        )
                     drv = getattr(runner.client, "_driver", None)
                     if drv is not None and hasattr(drv, "stats"):
                         # engine routing health (docs/metrics.md): WHY

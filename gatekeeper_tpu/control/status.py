@@ -24,6 +24,7 @@ STATUS_GROUP = "status.gatekeeper.sh"
 TEMPLATE_STATUS_GVK = GVK(STATUS_GROUP, "v1beta1", "ConstraintTemplatePodStatus")
 CONSTRAINT_STATUS_GVK = GVK(STATUS_GROUP, "v1beta1", "ConstraintPodStatus")
 MUTATOR_STATUS_GVK = GVK(STATUS_GROUP, "v1beta1", "MutatorPodStatus")
+PROVIDER_STATUS_GVK = GVK(STATUS_GROUP, "v1beta1", "ProviderPodStatus")
 STATUS_NAMESPACE = "gatekeeper-system"
 
 # label keys (apis/status/v1beta1: ConstraintTemplateNameLabel etc.)
@@ -33,6 +34,7 @@ CONSTRAINT_KIND_LABEL = "internal.gatekeeper.sh/constraint-kind"
 CONSTRAINT_NAME_LABEL = "internal.gatekeeper.sh/constraint-name"
 MUTATOR_KIND_LABEL = "internal.gatekeeper.sh/mutator-kind"
 MUTATOR_NAME_LABEL = "internal.gatekeeper.sh/mutator-name"
+PROVIDER_NAME_LABEL = "internal.gatekeeper.sh/provider-name"
 
 
 def _dashify(s: str) -> str:
@@ -194,6 +196,47 @@ class StatusWriter:
             MUTATOR_STATUS_GVK,
             STATUS_NAMESPACE,
             self._mutator_status_name(kind, name),
+        )
+
+    # -- external-data providers ----------------------------------------------
+
+    def _provider_status_name(self, name: str) -> str:
+        return f"{_dashify(self.pod_name)}-provider-{_dashify(name)}"
+
+    def publish_provider(
+        self,
+        name: str,
+        status: str,
+        error: Optional[str],
+        failure_policy: Optional[str] = None,
+    ) -> None:
+        """ProviderPodStatus: ingestion outcome per (pod, provider) —
+        spec errors ride `errors`, and the effective failurePolicy is
+        echoed so operators can audit the fail-open/fail-closed posture
+        per pod without reading the Provider spec."""
+        errors: List[Dict[str, str]] = []
+        if error:
+            errors.append({"code": "ingest_error", "message": error})
+        payload: Dict[str, Any] = {
+            "id": self.pod_name,
+            "providerUID": name,
+            "active": status == "active",
+            "errors": errors,
+        }
+        if failure_policy is not None:
+            payload["failurePolicy"] = failure_policy
+        self._apply(
+            PROVIDER_STATUS_GVK,
+            self._provider_status_name(name),
+            {POD_LABEL: self.pod_name, PROVIDER_NAME_LABEL: name},
+            payload,
+        )
+
+    def delete_provider(self, name: str) -> None:
+        self.cluster.delete(
+            PROVIDER_STATUS_GVK,
+            STATUS_NAMESPACE,
+            self._provider_status_name(name),
         )
 
 

@@ -129,9 +129,13 @@ class ProgramEvaluator:
         tok: Dict[str, np.ndarray],
         g: int = 8,
         overlay: Optional[Dict[str, Any]] = None,
+        row: Optional[Dict[str, np.ndarray]] = None,
     ):
         """`overlay` (ephemeral batches): {"v_base", "member", "capture",
-        "tabs"} vocab-overlay blocks for ids >= v_base."""
+        "tabs"} vocab-overlay blocks for ids >= v_base. `row`: per-row
+        feature planes ({name -> [N] bool}) consumed by ERowFeature —
+        the numpy mirror of the jax path's stage_row_feats (absent
+        names default True: coarse, sound)."""
         arrs = self._table_arrays()
         host = {
             k: (np.asarray(v) if not isinstance(v, np.ndarray) else v)
@@ -152,6 +156,7 @@ class ProgramEvaluator:
             consts=program.consts,
             g0=g0,
             g1=g1,
+            row=row,
             v_base=ov.get("v_base"),
             ov_member=ov.get("member"),
             ov_capture=ov.get("capture"),

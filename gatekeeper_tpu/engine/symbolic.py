@@ -161,6 +161,13 @@ class CompilerEnv:
     oracle_ns_shared: str = ""
     # constraint kind, for CompileUnsupported provenance only
     template_kind: str = ""
+    # external-data screen feature ("extdata:<kind>:<err|all>", set by
+    # the driver when the template's external_data calls are
+    # batch-extractable): external_data compiles as a screen whose
+    # per-row bits the dispatch layer fills from the response cache —
+    # in "err" mode (provably error-gated rules) clean cache-hit rows
+    # are skipped; in "all" mode the feature only drives prefetch
+    extdata_feature: Optional[str] = None
 
 
 class ConstPool:
@@ -637,6 +644,9 @@ class Compiler:
         # self-exclusion guards (`not identical(obj, input.review)`)
         self._clause_guards: List[Tuple[int, Tuple[int, ...]]] = []
         self._inv_root_n = 0  # fresh ids for inventory iterations
+        # extdata features recorded by external_data calls in the
+        # clause being compiled (ANDed in like _clause_joins)
+        self._clause_extfeats: List[str] = []
         self.row_features: List[str] = []  # features programs consume
         # outputs of compile_violation_counts for the compiled-render
         # path (engine/render.py): grouped violation branches with their
@@ -781,6 +791,7 @@ class Compiler:
     ) -> List[Tuple[Any, Tuple[str, ...], Expr, Optional[Expr], Any]]:
         flags_base = len(self._force_flags)
         joins_base = len(self._clause_joins)
+        extfeats_base = len(self._clause_extfeats)
         guards_base = len(self._clause_guards)
         prunes_base = len(self._clause_prunes)
         roots_base = self._inv_root_n
@@ -826,6 +837,25 @@ class Compiler:
                 # ALL dropped equalities are conjuncts: clause truth
                 # implies every joined key is matched by another object,
                 # so ANDing the bits stays sound and is sharpest
+                join_refine = f if join_refine is None else e_and(
+                    join_refine, f
+                )
+        clause_extfeats = sorted(set(self._clause_extfeats[extfeats_base:]))
+        del self._clause_extfeats[extfeats_base:]
+        if clause_extfeats:
+            from .exprs import ERowFeature
+
+            # external-data screen refinement: in "err" mode a clause
+            # through an error-gated external_data call can only fire
+            # when some row key is NOT a clean cache hit — AND the
+            # dispatch-supplied bit in (absent bits default True, so
+            # the screen degrades coarse, never unsound); "all"-mode
+            # bits are all-ones and exist to drive batch prefetch
+            for feat_name in clause_extfeats:
+                if feat_name not in self.row_features:
+                    self.row_features.append(feat_name)
+                    self.signature.append(("rowfeat", feat_name))
+                f = ERowFeature(feat_name)
                 join_refine = f if join_refine is None else e_and(
                     join_refine, f
                 )
@@ -1889,6 +1919,23 @@ class Compiler:
                 for v, s in outs
             ]
 
+        if name == "external_data":
+            # out-of-band lookup: never exactly compilable (the answer
+            # lives outside the review), but in screen mode the response
+            # is opaque and the clause gains the extdata row feature —
+            # the dispatch layer fills it from the batch-prefetched
+            # response cache, so clean cache-hit rows stay fused and
+            # only cold-miss/error rows take the interpreter rung
+            if not self.screen_mode:
+                raise CompileUnsupported(
+                    "external_data (compiles as a batch-prefetched screen)"
+                )
+            self.uses_inventory = True
+            self.opaque = True
+            feat = self.cenv.extdata_feature
+            if feat:
+                self._clause_extfeats.append(feat)
+            return [(SInventory(), st)]
         if any(isinstance(a, SInventory) for a in args):
             # calls over inventory values (identical(), flatten_selector,
             # re_match on an iterated apiversion, sprintf into the msg)

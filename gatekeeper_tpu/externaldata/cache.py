@@ -1,0 +1,140 @@
+"""TTL response cache with negative caching + stale-while-revalidate.
+
+The cache is what turns external data from a per-request RPC into a
+batch-plane concern: per micro-batch the system classifies every
+deduped key against this cache, fetches ONLY the misses in one outbound
+call, and serves everything else from memory. Three entry classes:
+
+  * positive (value, `cache_ttl_s`) — a provider answer for a key;
+  * negative (error, `negative_ttl_s`) — the provider *said* the key is
+    bad (unsigned image, unknown record); caching the error keeps a
+    storm of failing admissions from refetching the same doomed key
+    every batch;
+  * stale (expired positive within `stale_ttl_s`) — served immediately
+    while the batch's single fetch revalidates it; if the fetch fails,
+    the stale value still answers (counted as a stale-serve).
+
+The clock is injectable so TTL/stale windows are testable without
+sleeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# classification outcomes (also the cache_lookups_total result tag)
+HIT = "hit"
+NEGATIVE_HIT = "negative_hit"
+STALE = "stale"
+MISS = "miss"
+
+
+@dataclass
+class Entry:
+    value: Any = None
+    error: Optional[str] = None  # set => negative entry
+    fetched_at: float = 0.0
+    ttl: float = 0.0
+    stale_ttl: float = 0.0
+
+    def state(self, now: float) -> str:
+        age = now - self.fetched_at
+        if self.error is not None:
+            return NEGATIVE_HIT if age < self.ttl else MISS
+        if age < self.ttl:
+            return HIT
+        if age < self.ttl + self.stale_ttl:
+            return STALE
+        return MISS
+
+
+class ResponseCache:
+    """Per-(provider, key) entry store. Thread-safe; bounded per
+    provider (`max_entries`, LRU-ish eviction by fetched_at) so a
+    high-cardinality key space cannot grow memory without bound."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        max_entries: int = 65536,
+    ):
+        self._clock = clock
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str], Entry] = {}
+        # bumped on every write: lets consumers key derived state (e.g.
+        # precomputed row-feature bits) on cache content
+        self.generation = 0
+
+    def now(self) -> float:
+        return self._clock()
+
+    # -- reads ---------------------------------------------------------------
+
+    def classify(
+        self, provider: str, keys: List[str], now: Optional[float] = None
+    ) -> Dict[str, Tuple[str, Optional[Entry]]]:
+        """{key -> (state, entry|None)} for a key list, one lock hold."""
+        if now is None:
+            now = self._clock()
+        out: Dict[str, Tuple[str, Optional[Entry]]] = {}
+        with self._lock:
+            for k in keys:
+                e = self._entries.get((provider, k))
+                if e is None:
+                    out[k] = (MISS, None)
+                else:
+                    out[k] = (e.state(now), e)
+        return out
+
+    # -- writes --------------------------------------------------------------
+
+    def put(
+        self,
+        provider: str,
+        key: str,
+        value: Any = None,
+        error: Optional[str] = None,
+        ttl: float = 0.0,
+        stale_ttl: float = 0.0,
+    ) -> None:
+        with self._lock:
+            self._entries[(provider, key)] = Entry(
+                value=value,
+                error=error,
+                fetched_at=self._clock(),
+                ttl=ttl,
+                stale_ttl=stale_ttl,
+            )
+            self.generation += 1
+            if len(self._entries) > self.max_entries:
+                self._evict_locked()
+
+    def _evict_locked(self) -> None:
+        # drop the oldest 10%: eviction is rare (bounded key spaces in
+        # practice) so simplicity beats a true LRU list here
+        drop = max(1, len(self._entries) // 10)
+        for k in sorted(
+            self._entries, key=lambda k: self._entries[k].fetched_at
+        )[:drop]:
+            del self._entries[k]
+
+    def drop_provider(self, provider: str) -> None:
+        """Invalidate every entry of a provider (spec change/removal —
+        a new URL must not serve the old endpoint's answers)."""
+        with self._lock:
+            for k in [k for k in self._entries if k[0] == provider]:
+                del self._entries[k]
+            self.generation += 1
+
+    def wipe(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.generation += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -215,6 +215,20 @@ class Tracer:
         self._finish(span, registered=False)
         return span.context
 
+    def record_window(self, name: str, wall_start: float,
+                      perf_start: float, parent=None, trace_id=None,
+                      status: str = "ok", **attrs) -> Optional[SpanContext]:
+        """record_span for callers holding a (wall, perf_counter) start
+        pair: the end stamp is wall_start + the PERF-measured elapsed
+        time, so the span's duration is monotonic (an NTP step between
+        the two reads cannot stretch or invert it) while its timestamps
+        stay wall-readable — the same hybrid Span.__exit__ uses."""
+        end = wall_start + (time.perf_counter() - perf_start)
+        return self.record_span(
+            name, wall_start, end, parent=parent, trace_id=trace_id,
+            status=status, **attrs,
+        )
+
     def _finish(self, span: Span, registered: bool = True) -> None:
         with self._lock:
             ent = self._active.get(span.trace_id)

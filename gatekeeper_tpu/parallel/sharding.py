@@ -604,12 +604,17 @@ class FusedAuditKernel:
         )
 
     def stage_row_feats(
-        self, corpus: StackedCorpus, feats: Dict[str, np.ndarray]
+        self, corpus: StackedCorpus, feats: Dict[str, np.ndarray],
+        volatile: Sequence[str] = (),
     ) -> None:
         """Ship per-row feature bits ([N] bool each) to device as
-        [K, chunk] planes alongside the stacked corpus."""
+        [K, chunk] planes alongside the stacked corpus. Names already
+        staged are skipped (invdup bits are per-corpus-constant) unless
+        listed in `volatile` — external-data bits track the live
+        response cache, so a persistent audit corpus restages them
+        every dispatch."""
         for name, arr in feats.items():
-            if name in corpus.row_dev:
+            if name in corpus.row_dev and name not in volatile:
                 continue
             plane = np.zeros((corpus.k, corpus.chunk), bool)
             flat = np.asarray(arr, bool)

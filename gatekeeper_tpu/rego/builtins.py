@@ -376,6 +376,43 @@ def _is_type(t: str) -> Callable[[Any], bool]:
     return check
 
 
+def _external_data(req):
+    """Gatekeeper v3's external_data builtin: {"provider": name,
+    "keys": [...]} -> {"responses": [[k, v]...], "errors": [[k,
+    reason]...], "status_code", "system_error"}. Resolution goes
+    through the process's ExternalDataSystem (externaldata/binding.py):
+    cache-first, with the batch plane having prefetched the
+    micro-batch's deduped keys in ONE outbound fetch per provider. No
+    system bound or unknown provider -> undefined (counted), matching
+    OPA's behavior for an unconfigured builtin."""
+    _want(req, "object")
+    if "provider" not in req or "keys" not in req:
+        raise BuiltinError("external_data: want {provider, keys}")
+    provider = _want(req["provider"], "string")
+    keys_val = _want(req["keys"], "array", "set")
+    keys = []
+    items = (
+        sorted(keys_val, key=sort_key)
+        if isinstance(keys_val, frozenset)
+        else keys_val
+    )
+    for k in items:
+        _want(k, "string")
+        keys.append(k)
+    from ..externaldata import UnknownProviderError, get_system
+
+    system = get_system()
+    if system is None:
+        raise BuiltinError(
+            "external_data: no provider system configured"
+        )
+    try:
+        resp = system.resolve(provider, keys)
+    except UnknownProviderError as e:
+        raise BuiltinError(f"external_data: {e.args[0]}")
+    return freeze(resp)
+
+
 def _glob_match(pattern, delimiters, match):
     # glob.match with "*" wildcards per delimiter segment; the reference
     # snapshot's library does not use it, provided for API completeness.
@@ -438,6 +475,7 @@ BUILTINS: Dict[str, Tuple[int, Callable]] = {
     "is_null": (1, _is_type("null")),
     "is_set": (1, _is_type("set")),
     "glob.match": (3, _glob_match),
+    "external_data": (1, _external_data),
     # equality / comparison exposed as functions (used via operators mostly)
     "eq": (2, lambda a, b: rego_cmp(a, b) == 0),
     "neq": (2, lambda a, b: rego_cmp(a, b) != 0),

@@ -370,6 +370,17 @@ class MicroBatcher:
                 fut.set_exception(EvaluationUnavailable(str(e)))
             self._record_spans(batch, wall0, t0, route="unavailable")
             return
+        prefetch = getattr(self.client, "prefetch_external", None)
+        if prefetch is not None:
+            # a breaker-open batch never reached the fused path's
+            # prefetch: dedupe + fetch the batch's external-data keys
+            # once HERE so the per-request host evaluations below hit
+            # the cache (one outbound fetch per provider per batch on
+            # the degraded rung too)
+            try:
+                prefetch(reviews)
+            except Exception:
+                pass
         host = getattr(self.client, "review_host", None)
         if host is None:
             host = self.client.review

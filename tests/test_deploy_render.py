@@ -41,7 +41,8 @@ def test_default_render_shape():
     docs = render.render()
     ks = kinds(docs)
     # base CRDs + MutatorPodStatus + Assign/AssignMetadata/ModifySet
-    assert ks.count("CustomResourceDefinition") == 8
+    # + ProviderPodStatus + the external-data Provider CRD
+    assert ks.count("CustomResourceDefinition") == 10
     for k in (
         "Namespace",
         "ServiceAccount",

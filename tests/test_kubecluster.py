@@ -61,6 +61,10 @@ REGISTRY = [
     (GVK("constraints.gatekeeper.sh", "v1alpha1", "K8sRequiredLabels"),
      "k8srequiredlabels", False),
     (GVK("config.gatekeeper.sh", "v1alpha1", "Config"), "configs", True),
+    (GVK("externaldata.gatekeeper.sh", "v1alpha1", "Provider"),
+     "providers", False),
+    (GVK("status.gatekeeper.sh", "v1beta1", "ProviderPodStatus"),
+     "providerpodstatuses", True),
     (GVK("status.gatekeeper.sh", "v1beta1", "ConstraintPodStatus"),
      "constraintpodstatuses", True),
     (GVK("status.gatekeeper.sh", "v1beta1", "ConstraintTemplatePodStatus"),
