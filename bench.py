@@ -443,9 +443,31 @@ def run_bench(n_resources, n_constraints):
         file=err,
     )
 
-    print(
-        json.dumps(
-            {
+    # the north-star verdict must be honest (VERDICT Weak #1): a
+    # degraded 10kx100 CPU run can never report north_star_met — the
+    # claim requires the real platform AND the full workload; anything
+    # less carries the machine-readable why in degraded_reason
+    full_workload = n_resources >= 100_000 and n_constraints >= 500
+    ns_met = (
+        platform == "tpu"
+        and full_workload
+        and clean["sweep_seconds"] < 2.0
+    )
+    ns_reasons = []
+    if platform != "tpu":
+        ns_reasons.append(f"platform={platform} (tpu required)")
+    if not full_workload:
+        ns_reasons.append(
+            f"workload {n_resources}x{n_constraints} below 100000x500"
+        )
+    if degraded:
+        ns_reasons.append("degraded run")
+    if clean["sweep_seconds"] >= 2.0:
+        ns_reasons.append(
+            f"sweep {clean['sweep_seconds']:.2f}s >= 2s"
+        )
+    payload = (
+        {
                 "metric": "audit_constraint_evals_per_sec_per_chip",
                 "value": rate,
                 "unit": "evals/s",
@@ -476,11 +498,15 @@ def run_bench(n_resources, n_constraints):
                     "vs_go_proxy_estimate": round(vs_go_proxy, 2),
                     "go_speedup_proxy_assumed": GO_SPEEDUP_PROXY,
                     "north_star": "100k x 500 < 2s",
-                    "north_star_met": clean["sweep_seconds"] < 2.0,
+                    "north_star_met": ns_met,
+                    "degraded_reason": (
+                        "; ".join(ns_reasons) if ns_reasons else None
+                    ),
                 },
-            }
-        )
+        }
     )
+    print(json.dumps(payload))
+    print(summary_line(payload))
 
 
 # -- orchestration: platform decision, probe, degraded fallback -------------
@@ -489,6 +515,27 @@ CPU_FALLBACK_SIZE = (10_000, 100)  # CPU-feasible workload for the degraded run
 PROBE_TIMEOUT_S = 120  # tunnel backend init is ~15-60s when healthy
 TPU_CHILD_TIMEOUT_S = 5400
 CPU_CHILD_TIMEOUT_S = 3600
+
+
+def summary_line(parsed: dict) -> str:
+    """One short driver-parseable line with the headline numbers. The
+    full JSON line has outgrown the driver's capture buffer before
+    (BENCH_r05's parsed: null); this compact form survives truncation
+    while the complete artifact stays on the long line/file."""
+    det = parsed.get("detail") or {}
+    return "SUMMARY: " + json.dumps(
+        {
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit"),
+            "platform": parsed.get("platform"),
+            "degraded": parsed.get("degraded"),
+            "vs_baseline": parsed.get("vs_baseline"),
+            "north_star_met": det.get("north_star_met"),
+            "degraded_reason": det.get("degraded_reason"),
+            "webhook_p50_ms": det.get("webhook_p50_ms"),
+            "error": det.get("error"),
+        }
+    )
 
 
 def _probe_tpu(err):
@@ -592,6 +639,7 @@ def main():
         )
         if line is not None:
             print(line)
+            print(summary_line(json.loads(line)))
             return
         failures.append(f"tpu: {fail}")
         print(f"tpu child failed ({fail}); degrading to cpu", file=err)
@@ -610,23 +658,26 @@ def main():
     line, fail = _run_child(sizes, env, CPU_CHILD_TIMEOUT_S, err)
     if line is not None:
         print(line)
+        print(summary_line(json.loads(line)))
         return
     failures.append(f"cpu: {fail}")
 
     # last resort: the artifact still parses, carrying the failure story
-    print(
-        json.dumps(
-            {
-                "metric": "audit_constraint_evals_per_sec_per_chip",
-                "value": 0.0,
-                "unit": "evals/s",
-                "vs_baseline": 0.0,
-                "platform": "none",
-                "degraded": True,
-                "detail": {"error": "; ".join(failures)},
-            }
-        )
-    )
+    payload = {
+        "metric": "audit_constraint_evals_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "evals/s",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "degraded": True,
+        "detail": {
+            "error": "; ".join(failures),
+            "north_star_met": False,
+            "degraded_reason": "; ".join(failures),
+        },
+    }
+    print(json.dumps(payload))
+    print(summary_line(payload))
 
 
 if __name__ == "__main__":

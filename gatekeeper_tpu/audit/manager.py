@@ -445,9 +445,11 @@ class AuditManager:
         """Review one kind's page stream; a None RESTART marker (410
         continue-token expiry -> full relist) discards the partial
         results so objects are never double-counted."""
-        from ..constraint import AugmentedUnstructured
         from ..control.process import PROCESS_AUDIT
 
+        from ..constraint.handler import handler_for
+
+        handler = handler_for(self.client, self.target)
         results: List[Any] = []
         for chunk in pages:
             if chunk is None:  # RESTART: pagination began again
@@ -477,9 +479,9 @@ class AuditManager:
                     ns_obj = ns_cache[ns]
                     if ns_obj is None:
                         continue
-                    reviews.append(AugmentedUnstructured(obj, ns_obj))
+                    reviews.append(handler.wrap_audit_object(obj, ns_obj))
                 else:
-                    reviews.append(AugmentedUnstructured(obj, None))
+                    reviews.append(handler.wrap_audit_object(obj, None))
             if not reviews:
                 continue
             for responses in self.client.review_many(reviews):

@@ -27,12 +27,18 @@ from .errors import (  # noqa: F401
 )
 from .datastore import DataStore, PathConflictError  # noqa: F401
 from .driver import Driver, RegoDriver  # noqa: F401
+from .handler import (  # noqa: F401
+    TargetHandler,
+    WipeData,
+    default_handler,
+    label_selector_schema,
+    validate_label_selector,
+)
 from .target import (  # noqa: F401
     AdmissionRequest,
     AugmentedReview,
     AugmentedUnstructured,
     K8sValidationTarget,
-    WipeData,
 )
 from .templates import ConstraintTemplate, CRD  # noqa: F401
 from .client import Client, Backend  # noqa: F401

@@ -25,7 +25,6 @@ from .errors import (
     MissingTemplateError,
     UnrecognizedConstraintError,
 )
-from .target import K8sValidationTarget, WipeData
 from .templates import (
     CONSTRAINT_GROUP,
     CRD,
@@ -81,6 +80,13 @@ class Client:
             if not name or not _TARGET_NAME_RE.match(name):
                 raise ValueError(f"Invalid target name: {name!r}")
             self.targets[name] = t
+            # the driver resolves match semantics (oracle, tensor
+            # compile, feature encoding, audit listing) through the
+            # handler — register each so multi-target engines route
+            # per-target instead of assuming K8s (docs/targets.md)
+            register = getattr(self._driver, "register_target", None)
+            if register is not None:
+                register(t)
         self.allowed_data_fields = list(allowed_data_fields)
         # template name -> entry; (group, kind) -> {subpath: constraint}
         self._templates: Dict[str, _TemplateEntry] = {}

@@ -34,7 +34,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..rego import ast as A
 from ..rego.interp import Interpreter
-from . import match as M
+from . import hooks as H
+from .handler import TargetHandler, default_handler
 from .datastore import DataStore
 from .templates import CONSTRAINT_GROUP
 from .types import Response, Result
@@ -52,7 +53,7 @@ def _autoreject_result(constraint: Dict[str, Any], review: Any) -> Result:
         metadata={"details": {}},
         constraint=constraint,
         review=review,
-        enforcement_action=M.enforcement_action(constraint),
+        enforcement_action=H.enforcement_action(constraint),
     )
 
 
@@ -124,6 +125,21 @@ class RegoDriver(Driver):
         # and would otherwise happen once per evaluated violation
         self._data_version = 0
         self._frozen_inv: Dict[str, Tuple[int, Any]] = {}
+        # target name -> TargetHandler: the Client registers its
+        # handlers here so the engine resolves match semantics through
+        # the target boundary; unregistered names lazily resolve to the
+        # K8s default (every pre-multi-target call site assumed it)
+        self._target_handlers: Dict[str, TargetHandler] = {}
+
+    def register_target(self, handler: TargetHandler) -> None:
+        with self._mutex:
+            self._target_handlers[handler.get_name()] = handler
+
+    def _handler(self, target: str) -> TargetHandler:
+        h = self._target_handlers.get(target)
+        if h is None:
+            h = self._target_handlers[target] = default_handler()
+        return h
 
     def init(self) -> None:
         """No hook-library installation needed — hooks are native."""
@@ -225,10 +241,9 @@ class RegoDriver(Driver):
         return out
 
     def _ns_cache(self, target: str) -> Dict[str, Any]:
-        cache = self.storage.get(
-            ["external", target, "cluster", "v1", "Namespace"], {}
-        )
-        return cache if isinstance(cache, dict) else {}
+        """The target's review-context cache (K8s: synced Namespaces);
+        resolution is the handler's, the storage accessor ours."""
+        return self._handler(target).review_context_cache(self.storage.get)
 
     def _inventory(self, target: str) -> Any:
         """inventory rule (client/regolib/src.go:66-71), pre-frozen and
@@ -247,18 +262,23 @@ class RegoDriver(Driver):
     def _violation(
         self, target: str, input: Dict[str, Any], trace: Optional[List[str]]
     ) -> List[Result]:
-        review = M.hook_get_default(input, "review", {})
+        review = H.hook_get_default(input, "review", {})
+        handler = self._handler(target)
         constraints = self._constraints(target)
         ns_cache = self._ns_cache(target)
         inventory = self._inventory(target)
         results: List[Result] = []
+        # autoreject factors (match.needs_ns_selector docstring): the
+        # constraint half is handler.constraint_needs_context, the
+        # review half handler.review_autorejects
+        if constraints and handler.review_autorejects(review, ns_cache):
+            for constraint in constraints:
+                if handler.constraint_needs_context(constraint):
+                    results.append(_autoreject_result(constraint, review))
+                    if trace is not None:
+                        trace.append(f"autoreject: {_cname(constraint)}")
         for constraint in constraints:
-            if M.autoreject(constraint, review, ns_cache):
-                results.append(_autoreject_result(constraint, review))
-                if trace is not None:
-                    trace.append(f"autoreject: {_cname(constraint)}")
-        for constraint in constraints:
-            if not M.matches_constraint(constraint, review, ns_cache):
+            if not handler.matches_constraint(constraint, review, ns_cache):
                 if trace is not None:
                     trace.append(f"no match: {_cname(constraint)}")
                 continue
@@ -270,6 +290,7 @@ class RegoDriver(Driver):
         return results
 
     def _audit(self, target: str, trace: Optional[List[str]]) -> List[Result]:
+        handler = self._handler(target)
         constraints = self._constraints(target)
         if not constraints:
             return []
@@ -277,9 +298,11 @@ class RegoDriver(Driver):
         inventory = self._inventory(target)
         external = self.storage.get(["external", target], {})
         results: List[Result] = []
-        for review in M.iter_cached_reviews(external):
+        for review in handler.iter_cached_reviews(external):
             for constraint in constraints:
-                if not M.matches_constraint(constraint, review, ns_cache):
+                if not handler.matches_constraint(
+                    constraint, review, ns_cache
+                ):
                     continue
                 results.extend(
                     self._eval_template(
@@ -305,12 +328,12 @@ class RegoDriver(Driver):
             return []
         input_doc = {
             "review": review if frozen_review is None else frozen_review,
-            "parameters": M.constraint_parameters(constraint),
+            "parameters": H.constraint_parameters(constraint),
         }
         violations = self.interp.query_violations(
             ["templates", target, kind], input_doc, {"inventory": inventory}
         )
-        enforcement = M.enforcement_action(constraint)
+        enforcement = H.enforcement_action(constraint)
         out: List[Result] = []
         for v in violations:
             if not isinstance(v, dict) or "msg" not in v:
@@ -320,7 +343,7 @@ class RegoDriver(Driver):
             out.append(
                 Result(
                     msg=v["msg"],
-                    metadata={"details": M.hook_get_default(v, "details", {})},
+                    metadata={"details": H.hook_get_default(v, "details", {})},
                     constraint=constraint,
                     review=review,
                     enforcement_action=enforcement,

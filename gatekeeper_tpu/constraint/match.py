@@ -33,46 +33,15 @@ from __future__ import annotations
 
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-_MISSING = object()
-
-
-def get_default(obj: Any, field: str, default: Any) -> Any:
-    """target-lib get_default (target_template_source.go:110-125).
-
-    Null-valued fields count as missing.
-    """
-    if isinstance(obj, dict) and field in obj and obj[field] is not None:
-        return obj[field]
-    return default
-
-
-def hook_get_default(obj: Any, field: str, default: Any) -> Any:
-    """regolib hooks get_default (client/regolib/src.go:76-85).
-
-    Unlike the target lib's, a null value IS returned (only an absent key
-    falls back to the default).
-    """
-    if isinstance(obj, dict) and field in obj:
-        return obj[field]
-    return default
-
-
-def constraint_spec(constraint: Dict[str, Any]) -> Any:
-    return get_default(constraint, "spec", {})
-
-
-def constraint_match(constraint: Dict[str, Any]) -> Any:
-    return get_default(constraint_spec(constraint), "match", {})
-
-
-def enforcement_action(constraint: Dict[str, Any]) -> Any:
-    spec = hook_get_default(constraint, "spec", {})
-    return hook_get_default(spec, "enforcementAction", "deny")
-
-
-def constraint_parameters(constraint: Dict[str, Any]) -> Any:
-    spec = hook_get_default(constraint, "spec", {})
-    return hook_get_default(spec, "parameters", {})
+from .hooks import (  # noqa: F401  (re-exported: the M.* legacy surface)
+    _MISSING,
+    constraint_match,
+    constraint_parameters,
+    constraint_spec,
+    enforcement_action,
+    get_default,
+    hook_get_default,
+)
 
 
 # -- review field helpers ---------------------------------------------------
@@ -173,11 +142,7 @@ def _cached_ns(review: Any, ns_cache: Dict[str, Any]) -> Any:
 # -- label selector logic ---------------------------------------------------
 
 
-def rego_scalar_eq(a: Any, b: Any) -> bool:
-    """Rego equality for scalars: true != 1 (unlike Python), 1.0 == 1."""
-    if isinstance(a, bool) != isinstance(b, bool):
-        return False
-    return a == b
+from .hooks import rego_scalar_eq  # noqa: E402,F401  (legacy M.* surface)
 
 
 def values_shape(values: Any):
@@ -400,7 +365,15 @@ def matches_constraint(
     constraint: Dict[str, Any], review: Any, ns_cache: Dict[str, Any]
 ) -> bool:
     """matching_constraints body (:27-44) for a single constraint."""
-    match = constraint_match(constraint)
+    return matches_match(constraint_match(constraint), review, ns_cache)
+
+
+def matches_match(
+    match: Any, review: Any, ns_cache: Dict[str, Any]
+) -> bool:
+    """matches_constraint over a pre-extracted match block — the entry
+    point target handlers use after translating their own match schema
+    into this module's field vocabulary (docs/targets.md)."""
     if not any_kind_selector_matches(match, review):
         return False
     if not matches_namespaces(match, review):
@@ -434,7 +407,12 @@ def needs_ns_selector(constraint: Dict[str, Any]) -> bool:
     future per-constraint condition MUST be added here (and the batched
     device path in tpudriver._query_many_device revisited), never
     inlined into autoreject alone."""
-    return _has_field(constraint_match(constraint), "namespaceSelector")
+    return match_needs_ns_selector(constraint_match(constraint))
+
+
+def match_needs_ns_selector(match: Any) -> bool:
+    """needs_ns_selector over a pre-extracted (translated) match block."""
+    return _has_field(match, "namespaceSelector")
 
 
 def autoreject(

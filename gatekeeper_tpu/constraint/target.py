@@ -12,17 +12,17 @@ The Rego matching library the reference pairs with this handler
 from __future__ import annotations
 
 import json
-import re
 import urllib.parse
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from .errors import InvalidConstraintError
+from .handler import (
+    TargetHandler,
+    WipeData,  # noqa: F401  (historic home; re-exported for importers)
+    label_selector_schema,
+    validate_label_selector,
+)
 from .types import Result
-
-
-class WipeData:
-    """Sentinel: deletes the target's whole data subtree (target.go:37-41)."""
 
 
 @dataclass
@@ -78,8 +78,15 @@ def _unstructured_to_admission_request(obj: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
-class K8sValidationTarget:
-    """client.TargetHandler implementation for Kubernetes admission data."""
+class K8sValidationTarget(TargetHandler):
+    """TargetHandler implementation for Kubernetes admission data.
+
+    The engine's internal match/review vocabulary IS this target's
+    public schema, so every engine-facing TargetHandler default
+    (match_ir / matches_constraint / compile_match_specs / feature
+    encoding / audit listing) applies unchanged; only the K8s-specific
+    pieces — the Namespace context cache, AdmissionReview construction,
+    namespace exclusion, and warmup shapes — are overridden below."""
 
     def get_name(self) -> str:
         return "admission.k8s.gatekeeper.sh"
@@ -173,25 +180,7 @@ class K8sValidationTarget:
 
     def match_schema(self) -> Dict[str, Any]:
         string_list = {"type": "array", "items": {"type": "string"}}
-        label_selector = {
-            "type": "object",
-            "properties": {
-                "matchExpressions": {
-                    "type": "array",
-                    "items": {
-                        "type": "object",
-                        "properties": {
-                            "key": {"type": "string"},
-                            "operator": {
-                                "type": "string",
-                                "enum": ["In", "NotIn", "Exists", "DoesNotExist"],
-                            },
-                            "values": string_list,
-                        },
-                    },
-                }
-            },
-        }
+        label_selector = label_selector_schema()
         return {
             "type": "object",
             "properties": {
@@ -226,45 +215,88 @@ class K8sValidationTarget:
         for sel_field in ("labelSelector", "namespaceSelector"):
             selector = match.get(sel_field)
             if isinstance(selector, dict):
-                _validate_label_selector(selector, sel_field)
+                validate_label_selector(selector, sel_field)
 
+    # -- engine-facing overrides (docs/targets.md) --------------------------
 
-_LABEL_VALUE_RE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+    def review_context_cache(self, storage_get) -> Dict[str, Any]:
+        """The synced Namespace cache — what namespaceSelector and
+        autoreject resolve reviews against (target_template_source.go's
+        data.external.<t>.cluster.v1.Namespace lookups)."""
+        cache = storage_get(
+            ["external", self.get_name(), "cluster", "v1", "Namespace"], {}
+        )
+        return cache if isinstance(cache, dict) else {}
 
+    def augment_request(
+        self,
+        request: Dict[str, Any],
+        context_getter: Optional[Callable[[str], Optional[dict]]] = None,
+    ) -> Any:
+        """AdmissionRequest -> AugmentedReview with the Namespace object
+        attached (pkg/webhook/policy.go:354-369's nsCache.Get)."""
+        ns_obj = None
+        namespace = request.get("namespace", "")
+        if namespace and context_getter is not None:
+            ns_obj = context_getter(namespace)
+        return AugmentedReview(request, namespace=ns_obj)
 
-def _validate_label_selector(selector: Dict[str, Any], path: str) -> None:
-    """Mirrors metav1 validation.ValidateLabelSelector: operator-specific
-    values rules and label-value syntax for In/NotIn values."""
-    exprs = selector.get("matchExpressions")
-    if not isinstance(exprs, list):
-        return
-    for i, expr in enumerate(exprs):
-        if not isinstance(expr, dict):
-            raise InvalidConstraintError(
-                f"{path}.matchExpressions[{i}]: must be an object"
+    def wrap_audit_object(self, obj: Any, context: Any = None) -> Any:
+        return AugmentedUnstructured(obj, context)
+
+    def request_exempt(
+        self, request: Dict[str, Any], excluder: Any, process: str
+    ) -> Optional[str]:
+        namespace = request.get("namespace", "")
+        if (
+            namespace
+            and excluder is not None
+            and excluder.is_namespace_excluded(process, namespace)
+        ):
+            return "Namespace is set to be ignored by Gatekeeper config"
+        return None
+
+    def sample_requests(self, n: int) -> List[Dict[str, Any]]:
+        """Warmup AdmissionRequests: label counts vary so both
+        feature-shape buckets compile."""
+        out = []
+        for i in range(n):
+            obj = _warm_pod(1 + (i % 2) * 7)
+            out.append(
+                {
+                    "uid": f"warmup-{i}",
+                    "kind": {
+                        "group": "",
+                        "version": "v1",
+                        "kind": obj.get("kind", "Pod"),
+                    },
+                    "operation": "CREATE",
+                    "name": f"warmup-{i}",
+                    "namespace": "default",
+                    "userInfo": {"username": "system:warmup"},
+                    "object": obj,
+                }
             )
-        op = expr.get("operator")
-        values = expr.get("values") or []
-        if op in ("In", "NotIn"):
-            if not values:
-                raise InvalidConstraintError(
-                    f"{path}.matchExpressions[{i}].values: must be specified "
-                    f"when `operator` is 'In' or 'NotIn'"
-                )
-        elif op in ("Exists", "DoesNotExist"):
-            if values:
-                raise InvalidConstraintError(
-                    f"{path}.matchExpressions[{i}].values: may not be "
-                    f"specified when `operator` is 'Exists' or 'DoesNotExist'"
-                )
-        else:
-            raise InvalidConstraintError(
-                f"{path}.matchExpressions[{i}].operator: not a valid selector "
-                f"operator: {op!r}"
-            )
-        for v in values:
-            if not isinstance(v, str) or len(v) > 63 or not _LABEL_VALUE_RE.match(v):
-                raise InvalidConstraintError(
-                    f"{path}.matchExpressions[{i}].values: invalid label "
-                    f"value: {v!r}"
-                )
+        return out
+
+
+def _warm_pod(n_labels: int) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "warmup",
+            "namespace": "default",
+            "labels": {f"k{i}": f"v{i}" for i in range(n_labels)},
+        },
+        "spec": {
+            "containers": [
+                {
+                    "name": "main",
+                    "image": "warmup.invalid/img",
+                    "resources": {"limits": {"cpu": "1", "memory": "1Gi"}},
+                    "securityContext": {"privileged": False},
+                }
+            ]
+        },
+    }

@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Set, Tuple
 
-from ..constraint.target import WipeData
+from ..constraint.handler import WipeData
 from .events import DELETED, Event, GVK
 from .process import Excluder
 from .readiness import ReadinessTracker

@@ -358,9 +358,17 @@ class Runner:
         if OPERATION_WEBHOOK in self.operations:
             from ..webhook.server import WebhookServer
 
+            # the agent-action serving plane mounts automatically
+            # when the client was built with the agent target
+            # registered (docs/targets.md)
+            from ..agentaction import TARGET_NAME as _AGENT_TARGET
+
             self.webhook = WebhookServer(
                 self.client,
                 self.target,
+                agent_review=(
+                    _AGENT_TARGET in getattr(self.client, "targets", {})
+                ),
                 port=self.webhook_port,
                 excluder=self.excluder,
                 namespace_getter=self._get_namespace,

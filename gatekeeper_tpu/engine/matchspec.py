@@ -166,9 +166,20 @@ def _expand_kind_rows(match: Any) -> Optional[List[Tuple[int, int]]]:
 def compile_match_specs(
     constraints: Sequence[Dict[str, Any]], vocab: Vocab
 ) -> MatchSpecSet:
+    """Raw constraints -> tensors (the K8s identity translation)."""
+    return compile_match_irs(
+        [M.constraint_match(c) for c in constraints], vocab
+    )
+
+
+def compile_match_irs(
+    matches: Sequence[Any], vocab: Vocab
+) -> MatchSpecSet:
+    """Pre-extracted match blocks -> tensors. Target handlers translate
+    their public match schema into this module's field vocabulary first
+    (docs/targets.md); the K8s handler's translation is the identity."""
     per: List[Dict[str, Any]] = []
-    for c in constraints:
-        match = M.constraint_match(c)
+    for match in matches:
         raw_rows = _expand_kind_rows(match)
         if raw_rows is None:
             rows = [(WILDCARD, WILDCARD)]

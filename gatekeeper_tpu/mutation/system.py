@@ -34,8 +34,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..constraint import match as M
-from ..flatten.encoder import batch_review_features, encode_review_features
+from ..flatten.encoder import batch_review_features
 from ..flatten.vocab import Vocab
 from .mutators import ConvergenceError, Mutator, mutator_from_obj
 from .path import ListNode
@@ -97,11 +96,18 @@ def _review_gvk(review: Dict[str, Any]) -> Tuple[str, str, str]:
 
 
 class MutationSystem:
-    def __init__(self, metrics=None, logger=None):
+    def __init__(self, metrics=None, logger=None, target_handler=None):
+        from ..constraint.handler import default_handler
         from ..logs import null_logger
 
         self.metrics = metrics
         self.log = logger if logger is not None else null_logger()
+        # the target whose review/match vocabulary mutator Match specs
+        # speak: K8s by default; an AgentActionTarget makes this system
+        # rewrite tool-call arguments instead of pods (docs/targets.md)
+        self.target_handler = (
+            target_handler if target_handler is not None else default_handler()
+        )
         self._lock = threading.Lock()
         self._mutators: Dict[str, Mutator] = {}  # id -> mutator
         self._conflicts: Dict[str, List[str]] = {}
@@ -178,7 +184,6 @@ class MutationSystem:
         """(ordered mutators, device-ready match tensors) for the
         current generation; tensors cached until the set changes."""
         from ..engine.matchkernel import matchspec_to_device
-        from ..engine.matchspec import compile_match_specs
 
         with self._lock:
             gen = self._generation
@@ -195,7 +200,7 @@ class MutationSystem:
             if not muts:
                 self._spec_cache = (gen, [], None)
                 return [], None
-            specs = compile_match_specs(
+            specs = self.target_handler.compile_match_specs(
                 [{"spec": {"match": m.match}} for m in muts], self._vocab
             )
             ms = matchspec_to_device(specs)
@@ -224,7 +229,7 @@ class MutationSystem:
         # id — exactly the "never matches" semantics they need.
         overlay = OverlayVocab(self._vocab)
         feats = [
-            encode_review_features(r, ns_cache, overlay)
+            self.target_handler.encode_review_features(r, ns_cache, overlay)
             for r in reviews
         ]
         fb = batch_review_features(feats)
@@ -264,7 +269,7 @@ class MutationSystem:
     ) -> np.ndarray:
         return np.array(
             [
-                M.matches_constraint(
+                self.target_handler.matches_constraint(
                     {"spec": {"match": m.match}}, review, ns_cache
                 )
                 for m in muts

@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fail-policy", default="open",
                    choices=["open", "closed"])
     p.add_argument("--max-queue", type=int, default=2048)
+    # agent-action admission (docs/targets.md): registers the
+    # AgentActionTarget so agent templates ingest and the webhook
+    # serves POST /v1/agent/review
+    p.add_argument("--agent-review", action="store_true")
     p.add_argument("--kube-url", default=None)
     p.add_argument("--kube-token", default=None)
     p.add_argument("--kube-ca", default=None)
@@ -91,7 +95,12 @@ def build_runner(args, log=None, webhook_tls: bool = True):
         verify=not args.kube_insecure,
         logger=log,
     )
-    client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    targets = [K8sValidationTarget()]
+    if getattr(args, "agent_review", False):
+        from .agentaction import AgentActionTarget
+
+        targets.append(AgentActionTarget())
+    client = Backend(TpuDriver()).new_client(*targets)
     operations = tuple(args.operation) if args.operation else (
         "webhook", "audit", "status"
     )

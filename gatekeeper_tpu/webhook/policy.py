@@ -23,7 +23,6 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from ..constraint import AugmentedReview
 from ..constraint.errors import ConstraintFrameworkError
 from ..control import PROCESS_WEBHOOK, Excluder
 from ..faults import AdmissionUnavailable
@@ -178,6 +177,12 @@ class ValidationHandler:
             )
         self.fail_policy = fail_policy
         self.client = client
+        from ..constraint.handler import handler_for
+
+        # the target's handler owns review construction + exemption
+        # hooks (docs/targets.md); resolved once — the registry is
+        # fixed for the client's lifetime
+        self.target_handler = handler_for(client, target)
         # optional obs.Tracer: every handled request becomes a trace
         # (span taxonomy in docs/observability.md); denial log records
         # carry the trace_id for correlation
@@ -265,15 +270,11 @@ class ValidationHandler:
                 False, str(err), code=422 if user_err else 500
             )
 
-        namespace = request.get("namespace", "")
-        if (
-            namespace
-            and self.excluder is not None
-            and self.excluder.is_namespace_excluded(PROCESS_WEBHOOK, namespace)
-        ):
-            return AdmissionResponse(
-                True, "Namespace is set to be ignored by Gatekeeper config"
-            )
+        exempt_reason = self.target_handler.request_exempt(
+            request, self.excluder, PROCESS_WEBHOOK
+        )
+        if exempt_reason is not None:
+            return AdmissionResponse(True, exempt_reason)
 
         trace_enabled = dump = False
         if self.trace_config is not None:
@@ -320,12 +321,10 @@ class ValidationHandler:
             self._emit_trace(resp.trace)
         return resp.results if resp is not None else []
 
-    def _augment(self, request: Dict[str, Any]) -> AugmentedReview:
-        ns_obj = None
-        namespace = request.get("namespace", "")
-        if namespace and self.namespace_getter is not None:
-            ns_obj = self.namespace_getter(namespace)
-        return AugmentedReview(request, namespace=ns_obj)
+    def _augment(self, request: Dict[str, Any]):
+        return self.target_handler.augment_request(
+            request, self.namespace_getter
+        )
 
     def _deny_messages(
         self,

@@ -37,7 +37,6 @@ from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..constraint import AugmentedReview
 from ..faults import (
     CircuitBreaker,
     DeadlineExceeded,
@@ -76,28 +75,6 @@ def review_envelope(
     }
 
 
-def _warm_pod(n_labels: int) -> Dict[str, Any]:
-    return {
-        "apiVersion": "v1",
-        "kind": "Pod",
-        "metadata": {
-            "name": "warmup",
-            "namespace": "default",
-            "labels": {f"k{i}": f"v{i}" for i in range(n_labels)},
-        },
-        "spec": {
-            "containers": [
-                {
-                    "name": "main",
-                    "image": "warmup.invalid/img",
-                    "resources": {"limits": {"cpu": "1", "memory": "1Gi"}},
-                    "securityContext": {"privileged": False},
-                }
-            ]
-        },
-    }
-
-
 class MicroBatcher:
     """Collects admission requests into batches for fused evaluation.
 
@@ -128,6 +105,17 @@ class MicroBatcher:
     ):
         self.client = client
         self.target = target
+        # the target handler owns serving-plane review construction
+        # (K8s: AdmissionRequest -> AugmentedReview; agent: tool-call
+        # record -> AgentAction); client=None planes (MutateBatcher)
+        # build their own reviews in _dispatch, and clients without a
+        # target registry (test fakes) get the default handler
+        if client is not None:
+            from ..constraint.handler import handler_for
+
+            self.target_handler = handler_for(client, target)
+        else:
+            self.target_handler = None
         self.window = window_ms / 1000.0
         self.max_batch = max_batch
         self.max_queue = max_queue
@@ -309,13 +297,12 @@ class MicroBatcher:
         if not batch:
             return
         wall0, t0 = time.time(), time.perf_counter()
-        reviews = []
-        for request, _, _, _, _ in batch:
-            ns_obj = None
-            namespace = request.get("namespace", "")
-            if namespace and self.namespace_getter is not None:
-                ns_obj = self.namespace_getter(namespace)
-            reviews.append(AugmentedReview(request, namespace=ns_obj))
+        reviews = [
+            self.target_handler.augment_request(
+                request, self.namespace_getter
+            )
+            for request, _, _, _, _ in batch
+        ]
         breaker = self.breaker
         if breaker is not None and not breaker.allow():
             # breaker open: the fused path has been failing — go
@@ -517,6 +504,12 @@ class WebhookServer:
         # the pod IP surface ("0.0.0.0" via run.py) or the apiserver and
         # kubelet probes can never connect
         bind_addr: str = "127.0.0.1",
+        # agent-action plane (docs/targets.md): True wires
+        # POST /v1/agent/review over the client's registered
+        # AgentActionTarget; agent_mutation_system additionally screens
+        # and rewrites tool-call arguments before validation
+        agent_review: bool = False,
+        agent_mutation_system=None,
     ):
         self.client = client  # warmup() compiles through it
         self.tracer = tracer
@@ -559,6 +552,27 @@ class WebhookServer:
             fail_policy=fail_policy,
         )
         self.label_handler = NamespaceLabelHandler(exempt_namespaces)
+        self.agent_batcher = None
+        self.agent_mutate_batcher = None
+        self.agent_handler = None
+        if agent_review:
+            from ..agentaction import make_agent_plane
+
+            (
+                self.agent_batcher,
+                self.agent_mutate_batcher,
+                self.agent_handler,
+            ) = make_agent_plane(
+                client,
+                window_ms=window_ms,
+                mutation_system=agent_mutation_system,
+                metrics=metrics,
+                tracer=tracer,
+                logger=logger,
+                fail_policy=fail_policy,
+                request_timeout=request_timeout,
+                max_queue=max_queue,
+            )
         outer = self
 
         class _Handled(Exception):
@@ -581,6 +595,14 @@ class WebhookServer:
                             self.send_response(404)
                             raise _Handled()
                         resp = outer.mutation_handler.handle(request)
+                    elif self.path == "/v1/agent/review":
+                        if outer.agent_handler is None:
+                            payload = json.dumps(
+                                {"error": "agent review not enabled"}
+                            ).encode()
+                            self.send_response(404)
+                            raise _Handled()
+                        resp = outer.agent_handler.handle(request)
                     else:
                         resp = outer.handler.handle(request)
                     payload = json.dumps(
@@ -606,7 +628,14 @@ class WebhookServer:
             def log_message(self, *args):  # silence default stderr spam
                 pass
 
-        self._httpd = ThreadingHTTPServer((bind_addr, port), _Handler)
+        class _Server(ThreadingHTTPServer):
+            # the stdlib default backlog (5) resets bursts of
+            # concurrent connections — exactly the micro-batching
+            # workload; deep enough for a full batch window
+            request_queue_size = 512
+            daemon_threads = True
+
+        self._httpd = _Server((bind_addr, port), _Handler)
         self.rotator = None
         if tls:
             import ssl
@@ -632,6 +661,10 @@ class WebhookServer:
         self.batcher.start()
         if self.mutate_batcher is not None:
             self.mutate_batcher.start()
+        if self.agent_batcher is not None:
+            self.agent_batcher.start()
+        if self.agent_mutate_batcher is not None:
+            self.agent_mutate_batcher.start()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -644,31 +677,16 @@ class WebhookServer:
         the reference has no analog — its interpreter has no compile
         step, but it DOES gate Ready on state ingestion; compile warmth
         is this engine's equivalent). Returns seconds spent."""
+        from ..constraint.handler import handler_for
+
         t0 = time.monotonic()
+        handler = handler_for(self.client, self.batcher.target)
         if sample_objects is None:
-            # vary label counts so both feature-shape buckets warm
-            sample_objects = [
-                _warm_pod(1 + (i % 2) * 7) for i in range(192)
-            ]
-        reviews = []
-        for i, obj in enumerate(sample_objects):
-            reviews.append(
-                AugmentedReview(
-                    {
-                        "uid": f"warmup-{i}",
-                        "kind": {
-                            "group": "",
-                            "version": "v1",
-                            "kind": obj.get("kind", "Pod"),
-                        },
-                        "operation": "CREATE",
-                        "name": f"warmup-{i}",
-                        "namespace": "default",
-                        "userInfo": {"username": "system:warmup"},
-                        "object": obj,
-                    }
-                )
-            )
+            # the target supplies shape-covering synthetic requests
+            requests = handler.sample_requests(192)
+        else:
+            requests = sample_objects
+        reviews = [handler.augment_request(r) for r in requests]
         # device-sized batches covering the common occupancy buckets
         # (row counts bucket at 64/128/256; sub-device-threshold batches
         # route to the interpreter and need no compile).
@@ -693,6 +711,10 @@ class WebhookServer:
         self.batcher.stop()
         if self.mutate_batcher is not None:
             self.mutate_batcher.stop()
+        if self.agent_batcher is not None:
+            self.agent_batcher.stop()
+        if self.agent_mutate_batcher is not None:
+            self.agent_mutate_batcher.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -1,0 +1,625 @@
+"""AgentActionTarget: the second target handler (docs/targets.md).
+
+Pinned here:
+  * record normalization into the engine's internal review vocabulary
+    (tool globs <-> kind rows, agents <-> namespaces, capabilities <->
+    labels, skill provenance <-> the attached context object);
+  * oracle <-> kernel match parity over the agent match schema (the
+    translation must be lossless for the fused path to be exact);
+  * the full-stack e2e contract: 24 concurrent /v1/agent/review
+    requests against 3 agent templates (one external_data, one mutator
+    rewriting an argument) complete with ONE fused device dispatch per
+    micro-batch and zero interpreter renders on the cache-hit path;
+  * the genericity gate: no module outside the target boundary
+    references target-specific review/match fields or imports the
+    match-semantics engine directly.
+"""
+
+import ast
+import base64
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.agentaction import (
+    AgentAction,
+    AgentActionTarget,
+    SkillRecord,
+    TARGET_NAME,
+    split_tool,
+)
+from gatekeeper_tpu.constraint import (
+    Backend,
+    InvalidConstraintError,
+    K8sValidationTarget,
+    RegoDriver,
+)
+
+K8S_TARGET = "admission.k8s.gatekeeper.sh"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "gatekeeper_tpu")
+
+SHELL_REGO = """
+package agentshellallowlist
+allowed_cmd(c) { c == input.parameters.allowed[_] }
+violation[{"msg": msg}] {
+  cmd := input.review.object.spec.arguments.command
+  not allowed_cmd(cmd)
+  msg := sprintf("shell command <%v> is outside the allowlist", [cmd])
+}
+"""
+
+SIGNED_REGO = """
+package agentrequiresignedskills
+violation[{"msg": msg}] {
+  not input.review.object.spec.skill.signed
+  msg := sprintf("tool <%v> was invoked from an unsigned skill", [input.review.object.spec.tool])
+}
+"""
+
+VERIFIED_REGO = """
+package agentverifiedskills
+violation[{"msg": msg}] {
+  response := external_data({"provider": "skill-registry", "keys": [input.review.object.spec.skill.digest]})
+  count(response.errors) > 0
+  msg := sprintf("skill signature verification failed: %v", [response.errors])
+}
+"""
+
+
+def agent_template(kind, rego):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": TARGET_NAME, "rego": rego}],
+        },
+    }
+
+
+def agent_constraint(kind, name, match=None, params=None):
+    spec = {}
+    if match is not None:
+        spec["match"] = match
+    if params is not None:
+        spec["parameters"] = params
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def action(i=0, tool="shell.exec", command="ls", agent="planner-1",
+           signed=True, digest="sha256:abc", capabilities=("exec",),
+           **kw):
+    return AgentAction(
+        agent=agent,
+        session="s-1",
+        tool=tool,
+        arguments={"command": command},
+        capabilities=list(capabilities),
+        skill={"name": "fs-tools", "publisher": "acme",
+               "signed": signed, "digest": digest},
+        id=f"call-{i}",
+        **kw,
+    )
+
+
+# -- normalization -----------------------------------------------------------
+
+
+def test_split_tool():
+    assert split_tool("shell.exec") == ("shell", "exec")
+    assert split_tool("a.b.c") == ("a", "b.c")
+    assert split_tool("fetch") == ("tool", "fetch")
+
+
+def test_review_normalization():
+    t = AgentActionTarget()
+    handled, review = t.handle_review(action(7, capabilities=["exec", "net"]))
+    assert handled
+    assert review["kind"] == {"group": "shell", "version": "v1",
+                              "kind": "exec"}
+    assert review["namespace"] == "planner-1"
+    assert review["name"] == "call-7"
+    obj = review["object"]
+    assert obj["metadata"]["labels"] == {"exec": "true", "net": "true"}
+    assert obj["spec"]["tool"] == "shell.exec"
+    assert obj["spec"]["arguments"] == {"command": "ls"}
+    ctx = review["_unstable"]["namespace"]
+    assert ctx["metadata"]["labels"]["signed"] is True
+    assert ctx["metadata"]["labels"]["publisher"] == "acme"
+    # a skill-less record still carries a context object, so agent
+    # reviews can never autoreject
+    _, bare = t.handle_review(AgentAction(agent="a", tool="x"))
+    assert bare["_unstable"]["namespace"]["metadata"]["labels"] == {}
+    assert bare["kind"]["group"] == "tool"
+    assert not t.review_autorejects(bare, {})
+
+
+def test_handle_review_claims_only_agent_shapes():
+    t = AgentActionTarget()
+    k8s = K8sValidationTarget()
+    assert t.handle_review({"kind": {"group": "", "kind": "Pod"}})[0] is False
+    assert k8s.handle_review(action())[0] is False
+
+
+def test_handle_violation_resource():
+    from gatekeeper_tpu.constraint.types import Result
+
+    t = AgentActionTarget()
+    _, review = t.handle_review(action(3))
+    r = Result(msg="m", metadata={}, constraint={}, review=review,
+               enforcement_action="deny")
+    t.handle_violation(r)
+    assert r.resource["kind"] == "AgentAction"
+    assert r.resource["spec"]["tool"] == "shell.exec"
+    assert r.resource["metadata"]["agent"] == "planner-1"
+
+
+def test_validate_constraint_glob_grammar():
+    t = AgentActionTarget()
+    ok = agent_constraint("K", "c", match={"tools": ["*", "shell.*", "net.fetch"]})
+    t.validate_constraint(ok)
+    for bad in (["a*b"], ["*.b"], ["a.b.*"], [".*"], [7]):
+        with pytest.raises(InvalidConstraintError):
+            t.validate_constraint(agent_constraint("K", "c", match={"tools": bad}))
+    with pytest.raises(InvalidConstraintError):
+        t.validate_constraint(
+            agent_constraint("K", "c", match={"agents": ["x", 3]})
+        )
+    with pytest.raises(InvalidConstraintError):
+        t.validate_constraint(
+            agent_constraint(
+                "K", "c",
+                match={"skills": {"matchExpressions": [
+                    {"key": "k", "operator": "Bogus"}]}},
+            )
+        )
+
+
+# -- oracle <-> kernel parity over the agent schema --------------------------
+
+PARITY_MATCHES = [
+    None,
+    {},
+    {"tools": ["*"]},
+    {"tools": ["shell.*"]},
+    {"tools": ["shell.exec", "net.fetch"]},
+    {"tools": ["fetch"]},  # dotless: reserved group
+    {"tools": []},
+    {"tools": ["a*b"]},  # invalid glob: never matches, both paths
+    {"agents": ["planner-2"]},
+    {"excludedAgents": ["planner-1"]},
+    {"agents": ["planner-1"], "tools": ["shell.*"]},
+    {"capabilities": {"matchExpressions": [
+        {"key": "exec", "operator": "Exists"}]}},
+    {"capabilities": {"matchLabels": {"net": "true"}}},
+    {"skills": {"matchExpressions": [
+        {"key": "signed", "operator": "DoesNotExist"}]}},
+    {"skills": {"matchLabels": {"publisher": "acme"}}},
+    {"skills": {"matchExpressions": [
+        {"key": "publisher", "operator": "NotIn", "values": ["first-party"]}]}},
+]
+
+PARITY_ACTIONS = [
+    action(0),
+    action(1, tool="net.fetch", capabilities=("net",)),
+    action(2, tool="fetch", capabilities=()),
+    action(3, agent="planner-2", signed=False),
+    AgentAction(agent="planner-1", tool="shell.exec", id="bare"),
+    action(5, tool="shell.run", capabilities=("exec", "net")),
+]
+
+
+def test_agent_match_oracle_kernel_parity():
+    """The schema translation must be lossless: the host oracle and the
+    fused kernel agree bit-for-bit over the agent match battery."""
+    from gatekeeper_tpu.engine.matchkernel import (
+        features_to_device,
+        match_matrix,
+        matchspec_to_device,
+    )
+    from gatekeeper_tpu.flatten.encoder import batch_review_features
+    from gatekeeper_tpu.flatten.vocab import Vocab
+
+    t = AgentActionTarget()
+    constraints = [
+        agent_constraint("K", f"c{i}", match=m)
+        for i, m in enumerate(PARITY_MATCHES)
+    ]
+    reviews = [t.handle_review(a)[1] for a in PARITY_ACTIONS]
+    vocab = Vocab()
+    specs = t.compile_match_specs(constraints, vocab)
+    fb = batch_review_features(
+        [t.encode_review_features(r, {}, vocab) for r in reviews]
+    )
+    got = np.asarray(
+        match_matrix(matchspec_to_device(specs), features_to_device(fb))
+    ).astype(bool)
+    want = np.zeros_like(got)
+    for i, c in enumerate(constraints):
+        for j, r in enumerate(reviews):
+            want[i, j] = t.matches_constraint(c, r, {})
+    assert (got == want).all(), (
+        np.argwhere(got != want).tolist(),
+    )
+    # sanity on the battery itself: every dimension discriminates
+    assert want[3].any() and not want[3].all()   # shell.* glob
+    assert want[8].any() and not want[8].all()   # agents
+    assert want[13].any() and not want[13].all()  # skills selector
+
+
+# -- client end-to-end (interpreter driver) ----------------------------------
+
+
+def make_agent_client(driver=None):
+    client = Backend(driver or RegoDriver()).new_client(
+        K8sValidationTarget(), AgentActionTarget()
+    )
+    client.add_template(agent_template("AgentShellAllowlist", SHELL_REGO))
+    client.add_constraint(
+        agent_constraint(
+            "AgentShellAllowlist", "shell-allowlist",
+            match={"tools": ["shell.*"]},
+            params={"allowed": ["ls", "cat"]},
+        )
+    )
+    return client
+
+
+def test_review_routes_to_agent_target():
+    client = make_agent_client()
+    out = client.review(action(0, command="rm"))
+    res = out.by_target[TARGET_NAME].results
+    assert len(res) == 1
+    assert "outside the allowlist" in res[0].msg
+    assert res[0].resource["kind"] == "AgentAction"
+    # K8s target never claims the record
+    assert K8S_TARGET not in out.by_target
+    # allowed command, and a tool outside the glob
+    assert not client.review(action(1)).by_target[TARGET_NAME].results
+    assert not client.review(
+        action(2, tool="net.fetch", command="rm")
+    ).by_target[TARGET_NAME].results
+
+
+def test_agent_audit_over_ingested_actions():
+    client = make_agent_client()
+    client.add_data(action(0, command="rm"))
+    client.add_data(action(1, command="ls"))
+    client.add_data(SkillRecord(name="fs-tools", labels={"signed": True}))
+    res = client.audit().by_target[TARGET_NAME].results
+    assert len(res) == 1
+    assert res[0].resource["spec"]["arguments"] == {"command": "rm"}
+    # wipe clears the agent subtree too
+    from gatekeeper_tpu.constraint import WipeData
+
+    client.remove_data(action(0, command="rm"))
+    assert not client.audit().by_target[TARGET_NAME].results
+    assert WipeData is not None
+
+
+def test_agent_mutation_rewrites_arguments():
+    """Assign rewrites a tool call's arguments the way it rewrites a
+    pod: agent-schema Match, kernel screen, fixpoint apply."""
+    from gatekeeper_tpu.mutation.system import MutationSystem
+
+    t = AgentActionTarget()
+    system = MutationSystem(target_handler=t)
+    system.upsert(
+        {
+            "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+            "kind": "Assign",
+            "metadata": {"name": "default-timeout"},
+            "spec": {
+                "applyTo": [
+                    {"groups": ["shell"], "versions": ["v1"],
+                     "kinds": ["exec"]}
+                ],
+                "match": {"tools": ["shell.*"]},
+                "location": "spec.arguments.timeoutSeconds",
+                "parameters": {
+                    "pathTests": [
+                        {"subPath": "spec.arguments.timeoutSeconds",
+                         "condition": "MustNotExist"}
+                    ],
+                    "assign": {"value": 30},
+                },
+            },
+        }
+    )
+    review = t.review_of(action(0))
+    muts, mat = system.screen_host([review])
+    assert mat.shape == (1, 1) and mat[0, 0]
+    mutated, iters = system.apply(review["object"], review, list(muts))
+    assert mutated["spec"]["arguments"]["timeoutSeconds"] == 30
+    assert review["object"]["spec"]["arguments"] == {"command": "ls"}
+    # a non-shell action is screened out
+    other = t.review_of(action(1, tool="net.fetch"))
+    _, mat2 = system.screen_host([other])
+    assert not mat2[0, 0]
+
+
+# -- the /v1/agent/review contract e2e (fused driver) ------------------------
+
+
+@pytest.mark.slow
+def test_agent_review_contract_e2e(stub_provider):
+    """24 concurrent /v1/agent/review requests, 3 agent templates (one
+    external_data, one mutator rewriting an argument): ONE fused device
+    dispatch per micro-batch, one kernel mutation screen, zero
+    interpreter renders and zero provider fetches on the cache-hit
+    path — asserted via the existing driver/batcher telemetry."""
+    import urllib.request
+
+    from gatekeeper_tpu.constraint.tpudriver import TpuDriver
+    from gatekeeper_tpu.externaldata import ExternalDataSystem
+    from gatekeeper_tpu.mutation.system import MutationSystem
+    from gatekeeper_tpu.webhook.server import WebhookServer
+
+    system = ExternalDataSystem()
+    system.upsert(stub_provider.provider_obj(name="skill-registry"))
+    driver = TpuDriver(use_jax=True)
+    client = Backend(driver).new_client(
+        K8sValidationTarget(), AgentActionTarget()
+    )
+    client.set_external_data(system)
+    client.add_template(agent_template("AgentShellAllowlist", SHELL_REGO))
+    client.add_template(
+        agent_template("AgentRequireSignedSkills", SIGNED_REGO)
+    )
+    client.add_template(agent_template("AgentVerifiedSkills", VERIFIED_REGO))
+    client.add_constraint(
+        agent_constraint(
+            "AgentShellAllowlist", "shell-allowlist",
+            match={"tools": ["shell.*"]},
+            params={"allowed": ["ls", "cat"]},
+        )
+    )
+    client.add_constraint(
+        agent_constraint(
+            "AgentRequireSignedSkills", "signed", match={"tools": ["*"]}
+        )
+    )
+    client.add_constraint(
+        agent_constraint(
+            "AgentVerifiedSkills", "verified", match={"tools": ["*"]}
+        )
+    )
+    mutation_system = MutationSystem(target_handler=AgentActionTarget())
+    mutation_system.upsert(
+        {
+            "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+            "kind": "Assign",
+            "metadata": {"name": "default-timeout"},
+            "spec": {
+                "applyTo": [
+                    {"groups": ["shell"], "versions": ["v1"],
+                     "kinds": ["exec"]}
+                ],
+                "match": {"tools": ["shell.*"]},
+                "location": "spec.arguments.timeoutSeconds",
+                "parameters": {
+                    "pathTests": [
+                        {"subPath": "spec.arguments.timeoutSeconds",
+                         "condition": "MustNotExist"}
+                    ],
+                    "assign": {"value": 30},
+                },
+            },
+        }
+    )
+
+    def mutated_action(i):
+        a = action(i)
+        a.arguments = dict(a.arguments, timeoutSeconds=30)
+        return a
+
+    # compile the fused path for both the pre- and post-mutation shapes,
+    # then prime the external-data cache so the HTTP batch is cache-hit
+    assert client.warm_review_path([action(i) for i in range(24)])
+    assert client.warm_review_path([mutated_action(i) for i in range(24)])
+    client.review_many([mutated_action(i) for i in range(16)])
+    fetches_before = stub_provider.fetch_count
+    cold_before = driver.cold_batches
+
+    server = WebhookServer(
+        client,
+        K8S_TARGET,
+        window_ms=150.0,
+        agent_review=True,
+        agent_mutation_system=mutation_system,
+    )
+    server.start()
+    try:
+        screen_before = mutation_system.screen_dispatches
+        url = f"http://127.0.0.1:{server.port}/v1/agent/review"
+        barrier = threading.Barrier(24)
+        responses = [None] * 24
+        errors = []
+
+        def post(i):
+            body = json.dumps(
+                {
+                    "apiVersion": "agentaction.gatekeeper.sh/v1",
+                    "kind": "AgentActionReview",
+                    "request": {
+                        "uid": f"call-{i}",
+                        "id": f"call-{i}",
+                        "agent": "planner-1",
+                        "session": "s-1",
+                        "tool": "shell.exec",
+                        "arguments": {"command": "ls"},
+                        "capabilities": ["exec"],
+                        "skill": {"name": "fs-tools", "publisher": "acme",
+                                  "signed": True, "digest": "sha256:abc"},
+                    },
+                }
+            ).encode()
+            try:
+                barrier.wait(timeout=10)
+                with urllib.request.urlopen(url, data=body, timeout=30) as f:
+                    responses[i] = json.loads(f.read())
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append((i, repr(e)))
+
+        threads = [
+            threading.Thread(target=post, args=(i,)) for i in range(24)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        assert not errors, errors
+        for r in responses:
+            resp = r["response"]
+            assert resp["allowed"] is True, resp
+            # the mutator rewrote the argument; the patch rides back
+            ops = json.loads(base64.b64decode(resp["patch"]))
+            assert {
+                "op": "add",
+                "path": "/spec/arguments/timeoutSeconds",
+                "value": 30,
+            } in ops
+        # ONE fused device dispatch for the whole micro-batch, ONE
+        # kernel mutation screen, zero interpreter renders, zero
+        # fetches (cache-hit), zero cold (interpreter-served) batches
+        assert server.agent_batcher.batches_dispatched == 1
+        assert server.agent_batcher.requests_batched == 24
+        assert server.agent_mutate_batcher.batches_dispatched == 1
+        assert mutation_system.screen_dispatches == screen_before + 1
+        assert driver.stats["interp_rendered_pairs"] == 0
+        assert driver.stats["compiled_pairs"] == 24 * 3
+        assert driver.stats["n_reviews"] == 24
+        assert driver.cold_batches == cold_before
+        assert stub_provider.fetch_count == fetches_before
+    finally:
+        server.stop()
+
+
+# -- the genericity gate -----------------------------------------------------
+
+# target-specific review/match vocabulary: only the target boundary may
+# reference these (the match-semantics engine modules define them; the
+# K8s and agent handlers translate to them; nothing else touches them)
+_GATE_TOKENS = {"apiGroups", "namespaceSelector", "excludedNamespaces"}
+_GATE_NAMES = {"AdmissionRequest", "AugmentedReview", "AugmentedUnstructured"}
+_GATE_ALLOWED = {
+    "constraint/target.py",      # the K8s handler
+    "constraint/handler.py",     # the boundary itself
+    "constraint/__init__.py",    # public re-exports
+    "constraint/match.py",       # the match-semantics oracle
+    "engine/matchspec.py",       # its tensor compiler
+    "agentaction/target.py",     # the agent handler's translation
+    # the K8s Config CRD's process-exclusion schema (config.gatekeeper.sh
+    # match.excludedNamespaces) — the K8s control plane's own CR, reached
+    # by the webhook only through TargetHandler.request_exempt
+    "control/process.py",
+}
+# modules allowed to import the match-semantics engine directly (the
+# boundary, the engine's own internals, and public re-exports)
+_SEMANTICS_MODULES = {"match", "matchspec", "target"}
+_IMPORT_ALLOWED = _GATE_ALLOWED | {
+    "engine/__init__.py",
+    "engine/matchkernel.py",
+    "flatten/encoder.py",
+    "agentaction/__init__.py",
+    "agentaction/review.py",
+}
+
+
+def _pkg_modules():
+    for root, _dirs, files in os.walk(PKG):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                path = os.path.join(root, fn)
+                yield os.path.relpath(path, PKG).replace(os.sep, "/"), path
+
+
+def _code_strings(tree):
+    """String constants excluding docstrings (bare-Expr strings)."""
+    doc_ids = set()
+    for node in ast.walk(tree):
+        body = getattr(node, "body", None)
+        if isinstance(body, list):
+            for stmt in body:
+                if (
+                    isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)
+                ):
+                    doc_ids.add(id(stmt.value))
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and id(node) not in doc_ids
+        ):
+            yield node.value
+
+
+def test_genericity_gate_no_k8s_fields_outside_targets():
+    """No module outside the target boundary references the
+    target-specific review/match vocabulary — K8s semantics are reached
+    only through the TargetHandler interface."""
+    offenders = []
+    for rel, path in _pkg_modules():
+        if rel in _GATE_ALLOWED:
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read())
+        hits = set()
+        for s in _code_strings(tree):
+            hits.update(t for t in _GATE_TOKENS if t in s.split())
+            hits.update(t for t in _GATE_TOKENS if s == t)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name) and node.id in _GATE_NAMES:
+                hits.add(node.id)
+            elif isinstance(node, ast.Attribute) and node.attr in _GATE_NAMES:
+                hits.add(node.attr)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    if alias.name in _GATE_NAMES:
+                        hits.add(alias.name)
+        if hits:
+            offenders.append((rel, sorted(hits)))
+    assert not offenders, offenders
+
+
+def test_genericity_gate_semantics_imports_confined():
+    """The match-semantics engine modules are imported only by the
+    target boundary and the engine's own internals — drivers, webhook,
+    mutation, audit, and control reach them only through handlers."""
+    offenders = []
+    for rel, path in _pkg_modules():
+        if rel in _IMPORT_ALLOWED:
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                leaf = mod.rsplit(".", 1)[-1]
+                if leaf in _SEMANTICS_MODULES:
+                    offenders.append((rel, f"from {mod} import ..."))
+                elif leaf in ("constraint", "engine") or mod == "":
+                    for alias in node.names:
+                        if alias.name in _SEMANTICS_MODULES:
+                            offenders.append(
+                                (rel, f"from {mod} import {alias.name}")
+                            )
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    leaf = alias.name.rsplit(".", 1)[-1]
+                    if leaf in _SEMANTICS_MODULES:
+                        offenders.append((rel, f"import {alias.name}"))
+    assert not offenders, offenders

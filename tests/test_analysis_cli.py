@@ -238,3 +238,51 @@ def test_unsupported_path_rejected(tmp_path):
     p.write_text("hi")
     with pytest.raises(SystemExit):
         run([str(p)])
+
+
+# -- agent template library (docs/targets.md) --------------------------------
+
+AGENT_DIR = os.path.join(DEPLOY, "agent")
+AGENT_BASELINE = os.path.join(DEPLOY, "agent-baseline.json")
+
+
+def test_agent_library_holds_the_baseline(capsys):
+    """The agent-target policy library is pinned by its own manifest:
+    a verdict regression in deploy/policies/agent/ fails the build."""
+    rc = run([AGENT_DIR, "--baseline", AGENT_BASELINE])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "OK:" in out
+
+
+def test_agent_baseline_manifest_is_current():
+    from gatekeeper_tpu.analysis.cli import _analyze_one, collect_templates
+
+    with open(AGENT_BASELINE) as f:
+        recorded = json.load(f)["templates"]
+    current = {}
+    for src, obj in collect_templates([AGENT_DIR]):
+        rep = _analyze_one(src, obj)
+        current[rep.kind] = rep.verdict
+    assert current == recorded
+    # the shipped library: the four core agent policies compile to the
+    # fused path; the external-data consumer screens (PARTIAL_ROWS)
+    assert recorded.get("AgentShellAllowlist") == "VECTORIZED"
+    assert recorded.get("AgentNetworkDomains") == "VECTORIZED"
+    assert recorded.get("AgentRequireSignedSkills") == "VECTORIZED"
+    assert recorded.get("AgentArgShape") == "VECTORIZED"
+    assert recorded.get("AgentVerifiedSkills") == "PARTIAL_ROWS"
+
+
+def test_reference_library_ports_pinned_vectorized():
+    """The four ported reference-library policies are recorded in the
+    main baseline and all compile to the fused path."""
+    with open(BASELINE) as f:
+        recorded = json.load(f)["templates"]
+    for kind in (
+        "K8sRequiredLabels",
+        "K8sAllowedRepos",
+        "K8sBlockNodePort",
+        "K8sPSPPrivileged",
+    ):
+        assert recorded.get(kind) == "VECTORIZED", kind

@@ -532,3 +532,121 @@ def test_template_update_via_constructed_object(client):
     assert len(client.review(pod()).results()) == 1
     client.add_template(ct("package foo\nviolation[{\"msg\": \"n\"}] { 1 == 2 }\n"))
     assert client.review(pod()).results() == []
+
+
+# -- multi-target routing (docs/targets.md) ----------------------------------
+
+AGENT_DENY_ALL = """package foo
+violation[{"msg": "AGENT DENIED", "details": {}}] {
+    "always" == "always"
+}
+"""
+
+
+def _agent_template(kind, rego):
+    from gatekeeper_tpu.agentaction import TARGET_NAME
+
+    t = make_template(kind, rego)
+    t["spec"]["targets"][0]["target"] = TARGET_NAME
+    return t
+
+
+def _k8s_review():
+    return AugmentedReview(
+        {
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "operation": "CREATE",
+            "name": "mypod",
+            "namespace": "default",
+            "object": pod(),
+        }
+    )
+
+
+def _two_target_client(driver):
+    from gatekeeper_tpu.agentaction import AgentActionTarget
+
+    return Backend(driver).new_client(
+        K8sValidationTarget(), AgentActionTarget()
+    )
+
+
+def test_multi_target_templates_route_per_target(client_driver_factory=None):
+    from gatekeeper_tpu.agentaction import AgentAction, TARGET_NAME as AGENT
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    for driver in (RegoDriver(), TpuDriver()):
+        client = _two_target_client(driver)
+        client.add_template(make_template("DenyAll", DENY_ALL))
+        client.add_constraint(make_constraint("DenyAll", "deny-k8s"))
+        client.add_template(_agent_template("DenyCalls", AGENT_DENY_ALL))
+        client.add_constraint(make_constraint("DenyCalls", "deny-agent"))
+
+        r_k8s = client.review(_k8s_review())
+        assert set(r_k8s.by_target) == {TARGET}
+        assert [x.msg for x in r_k8s.by_target[TARGET].results] == ["DENIED"]
+
+        r_agent = client.review(
+            AgentAction(agent="a1", tool="shell.exec", id="c1")
+        )
+        assert set(r_agent.by_target) == {AGENT}
+        assert [x.msg for x in r_agent.by_target[AGENT].results] == [
+            "AGENT DENIED"
+        ]
+
+        # batched path routes identically with both targets live
+        outs = client.review_many(
+            [
+                _k8s_review(),
+                AgentAction(agent="a1", tool="shell.exec", id="c2"),
+            ]
+        )
+        assert set(outs[0].by_target) == {TARGET}
+        assert set(outs[1].by_target) == {AGENT}
+
+
+def test_retargeted_template_update_rehomes_constraints():
+    """The re-target path in Client.add_template: the old target's
+    modules and constraint data unmount, cached constraints re-home
+    under the new target, and evaluation flips sides — with BOTH
+    handlers live (the previously-untested _unmount_kind branch)."""
+    from gatekeeper_tpu.agentaction import AgentAction, TARGET_NAME as AGENT
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    for driver in (RegoDriver(), TpuDriver()):
+        client = _two_target_client(driver)
+        client.add_template(make_template("Portable", DENY_ALL))
+        client.add_constraint(make_constraint("Portable", "portable-c"))
+        k8s_review = _k8s_review()
+        agent_review = AgentAction(agent="a1", tool="shell.exec", id="c1")
+        assert client.review(k8s_review).by_target[TARGET].results
+        assert not client.review(agent_review).by_target[AGENT].results
+
+        # same template name, new target: must unmount + re-home
+        client.add_template(_agent_template("Portable", DENY_ALL))
+        assert not client.review(k8s_review).by_target[TARGET].results
+        assert client.review(agent_review).by_target[AGENT].results
+        # the constraint survived the move
+        assert client.get_constraint(
+            make_constraint("Portable", "portable-c")
+        )
+
+        # and back again
+        client.add_template(make_template("Portable", DENY_ALL))
+        assert client.review(k8s_review).by_target[TARGET].results
+        assert not client.review(agent_review).by_target[AGENT].results
+
+
+def test_multi_target_add_data_routes_per_handler():
+    from gatekeeper_tpu.agentaction import AgentAction, TARGET_NAME as AGENT
+
+    client = _two_target_client(RegoDriver())
+    resp = client.add_data(pod("p1"))
+    assert set(resp.handled) == {TARGET}
+    resp = client.add_data(
+        AgentAction(agent="a1", tool="shell.exec", id="c1")
+    )
+    assert set(resp.handled) == {AGENT}
+    # WipeData clears both subtrees
+    resp = client.remove_data(WipeData())
+    assert set(resp.handled) == {TARGET, AGENT}
