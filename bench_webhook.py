@@ -704,6 +704,177 @@ def run_external_bench(n_requests=3000, n_keys=7, err=sys.stderr):
     }
 
 
+def run_fleet_bench(n_requests=1200, n_keys=24, err=sys.stderr):
+    """The `--fleet` replay (docs/fleet.md): cold-fetch amplification
+    of the external-data plane as the webhook scales horizontally. A
+    load balancer spreads identical traffic over every replica, so
+    WITHOUT the fleet cache plane each of N replicas pays its own cold
+    fetch per key — amplification N. WITH the plane, the first replica
+    to fetch publishes and peers merge: amplification stays ~1.
+
+    Phases: n1 (one replica, the floor), n2_isolated (two replicas, no
+    fleet — the regression this subsystem removes), n2_fleet (two
+    replicas gossiping through one FakeCluster). Reports fetches per
+    key for each and the headline cold_fetch_amplification ratio."""
+    from gatekeeper_tpu.constraint import (
+        Backend,
+        K8sValidationTarget,
+        TpuDriver,
+    )
+    from gatekeeper_tpu.control.events import FakeCluster
+    from gatekeeper_tpu.externaldata import ExternalDataSystem
+    from gatekeeper_tpu.fleet import FleetPlane
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    stub = _StubProviderHTTP()
+
+    def build_replica(fleet_plane=None):
+        metrics = MetricsRegistry()
+        system = ExternalDataSystem(metrics=metrics)
+        if fleet_plane is not None:
+            fleet_plane.attach_cache(system)
+        system.upsert({
+            "apiVersion": "externaldata.gatekeeper.sh/v1alpha1",
+            "kind": "Provider",
+            "metadata": {"name": "bench-provider"},
+            "spec": {
+                "url": stub.url,
+                "timeout": 5,
+                "failurePolicy": "Ignore",
+                "cacheTTLSeconds": 3600,
+                "negativeCacheTTLSeconds": 3600,
+            },
+        })
+        client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+        client.set_external_data(system)
+        client.add_template({
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "externalbench"},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": "ExternalBench"}}},
+                "targets": [{"target": TARGET, "rego": _EXTERNAL_REGO}],
+            },
+        })
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "ExternalBench",
+            "metadata": {"name": "eb"},
+            "spec": {"match": {"kinds": [
+                {"apiGroups": [""], "kinds": ["Pod"]}
+            ]}},
+        })
+        batcher = MicroBatcher(
+            client, TARGET, window_ms=2.0, metrics=metrics
+        )
+        handler = BatchedValidationHandler(
+            batcher, request_timeout=30, metrics=metrics
+        )
+        batcher.start()
+        return system, batcher, handler
+
+    def ext_request(i):
+        r = make_request(i, violating=False)
+        r["object"]["spec"]["containers"][0]["image"] = (
+            f"reg.example/app{i % n_keys}"
+        )
+        return r
+
+    phases = []
+
+    def run_phase(name, handlers, planes=()):
+        """Drive every handler with the SAME key universe (the load-
+        balancer model) and count fleet-wide outbound fetches."""
+        f0 = stub.fetches
+        n_sub = max(n_keys * 4, n_requests // max(1, len(handlers)))
+        rows = []
+        for j, handler in enumerate(handlers):
+            if j > 0 and planes:
+                # the LB does not barrier on gossip, but a steady-state
+                # fleet has had a publish interval between cold bursts;
+                # give the plane one propagation window
+                deadline = time.monotonic() + 5.0
+                while (
+                    time.monotonic() < deadline
+                    and planes[j].cache_merged < n_keys
+                ):
+                    time.sleep(0.01)
+            rows.append(
+                replay(handler, [ext_request(i) for i in range(n_sub)], 64)
+            )
+        fetches = stub.fetches - f0
+        r = {
+            "phase": name,
+            "replicas": len(handlers),
+            "keys": n_keys,
+            "fetches": fetches,
+            "fetches_per_key": round(fetches / n_keys, 3),
+            "p50_ms": max(row["p50_ms"] for row in rows),
+            "p99_ms": max(row["p99_ms"] for row in rows),
+        }
+        phases.append(r)
+        print(f"fleet phase: {r}", file=err)
+        return r
+
+    # n1: one replica — the cold-fetch floor (1 fetch per key)
+    sys1, b1, h1 = build_replica()
+    try:
+        _warm_route(b1.client)
+        r1 = run_phase("n1", [h1])
+    finally:
+        b1.stop()
+
+    # n2_isolated: two replicas, no fleet — every replica re-pays
+    stub.fetches = 0
+    sys_a, b_a, h_a = build_replica()
+    sys_b, b_b, h_b = build_replica()
+    try:
+        _warm_route(b_a.client)
+        r2i = run_phase("n2_isolated", [h_a, h_b])
+    finally:
+        b_a.stop()
+        b_b.stop()
+
+    # n2_fleet: two replicas gossiping through one cluster
+    stub.fetches = 0
+    cluster = FakeCluster()
+    p_a = FleetPlane(cluster, "bench-a", publish_interval_s=0.02)
+    p_b = FleetPlane(cluster, "bench-b", publish_interval_s=0.02)
+    sys_fa, b_fa, h_fa = build_replica(p_a)
+    sys_fb, b_fb, h_fb = build_replica(p_b)
+    p_a.start()
+    p_b.start()
+    try:
+        _warm_route(b_fa.client)
+        r2f = run_phase(
+            "n2_fleet", [h_fa, h_fb], planes=[p_a, p_b]
+        )
+    finally:
+        p_a.stop()
+        p_b.stop()
+        b_fa.stop()
+        b_fb.stop()
+        stub.stop()
+
+    return {
+        "keys": n_keys,
+        "phases": phases,
+        "fetches_per_key_n1": r1["fetches_per_key"],
+        "fetches_per_key_n2_isolated": r2i["fetches_per_key"],
+        "fetches_per_key_n2_fleet": r2f["fetches_per_key"],
+        # the headline: how much extra cold-fetch cost the second
+        # replica adds WITH the fleet plane (1.0 = none)
+        "cold_fetch_amplification": round(
+            r2f["fetches_per_key"] / max(r1["fetches_per_key"], 1e-9), 3
+        ),
+        "cache_merged": p_b.cache_merged + p_a.cache_merged,
+    }
+
+
 # the reference harness's constraint-count ladder
 # (pkg/webhook/policy_benchmark_test.go:265-276)
 LADDER = (5, 10, 50, 100, 200, 1000, 2000)
@@ -1024,7 +1195,10 @@ def _summarize(mode, res):
                     if k in last:
                         head[k] = last[k]
             for k in ("p50_ms", "p99_ms", "throughput_rps", "shed_rate",
-                      "hit_rate", "fetches_per_batch"):
+                      "hit_rate", "fetches_per_batch",
+                      "fetches_per_key_n1", "fetches_per_key_n2_isolated",
+                      "fetches_per_key_n2_fleet",
+                      "cold_fetch_amplification"):
                 if k in res:
                     head[k] = res[k]
     except Exception as e:  # the summary must never kill the artifact
@@ -1054,6 +1228,13 @@ if __name__ == "__main__":
         res = run_external_bench(n_req, n_keys)
         print(json.dumps(res))
         print(_summarize("external", res))
+    elif "--fleet" in sys.argv:
+        pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n_req = int(pos[0]) if pos else 1_200
+        n_keys = int(pos[1]) if len(pos) > 1 else 24
+        res = run_fleet_bench(n_req, n_keys)
+        print(json.dumps(res))
+        print(_summarize("fleet", res))
     elif "--mutate" in sys.argv:
         pos = [a for a in sys.argv[1:] if not a.startswith("--")]
         n_req = int(pos[0]) if pos else 10_000

@@ -15,10 +15,12 @@ Design notes carried over from the hand-written manifest:
     webhook + audit Deployments, each holding full replicated policy
     state; the audit pod schedules onto a TPU node (the fused sweep is
     the throughput path), webhook pods are CPU replicas;
-  * webhook replicas default to 1: the cert rotator stores its CA in
-    the pod-local --cert-dir; scaling needs a SHARED cert store (the
-    reference keeps the pair in a Secret, certs.go:119-181) so all
-    replicas serve one CA;
+  * webhook replicas default to 3 (docs/fleet.md): the cert store is
+    the SHARED Secret (`certSecret`, load-or-create + conflict retry,
+    rotation picked up by peers without restart — certs.go:119-181
+    behaviorally), the cache/breaker state plane gossips through
+    FleetState CRs, and a PodDisruptionBudget keeps at least one
+    replica through voluntary disruption;
   * the compile-cache volume turns pod restarts into warm boots; Ready
     gates on state replay only (serve-while-compiling), so a cold
     cache degrades latency briefly, never availability;
@@ -43,9 +45,15 @@ DEFAULT_VALUES: Dict[str, Any] = {
         "tag": "latest",
         "pullPolicy": "IfNotPresent",
     },
-    # webhook pods (CPU, latency path); see module docstring for the
-    # replicas=1 cert-store constraint
-    "replicas": 1,
+    # webhook pods (CPU, latency path): HA by default now that certs
+    # live in the shared Secret and cache/breaker state gossips through
+    # the fleet plane (docs/fleet.md)
+    "replicas": 3,
+    # the Secret-backed shared cert store (fleet.SecretCertStore); ""
+    # falls back to pod-local emptyDir certs (single-replica debugging)
+    "certSecret": "gatekeeper-webhook-server-cert",
+    # minimum webhook replicas that must survive voluntary disruption
+    "pdbMinAvailable": 1,
     "auditInterval": 60,
     "constraintViolationsLimit": 20,
     "auditFromCache": False,
@@ -223,6 +231,10 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
         # batched per micro-batch by the webhook pods
         _crd("externaldata.gatekeeper.sh", "Provider", "providers",
              "Cluster", ["v1alpha1"]),
+        # fleet state plane (docs/fleet.md): one CR per webhook replica
+        # gossiping external-data cache entries + breaker trips
+        _crd("fleet.gatekeeper.sh", "FleetState", "fleetstates",
+             "Namespaced", ["v1alpha1"]),
         # the mutation CRDs (pkg/mutation in the reference; the TPU
         # build screens their Match specs with the same kernel as
         # constraints)
@@ -265,6 +277,7 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
                         "config.gatekeeper.sh",
                         "constraints.gatekeeper.sh",
                         "externaldata.gatekeeper.sh",
+                        "fleet.gatekeeper.sh",
                         "mutations.gatekeeper.sh",
                         "templates.gatekeeper.sh",
                         "status.gatekeeper.sh",
@@ -323,6 +336,39 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
             },
         },
     ]
+    if v["certSecret"]:
+        # the shared cert store (docs/fleet.md): shipped EMPTY — the
+        # first replica to boot wins the load-or-create race and
+        # populates it; peers adopt its CA and pick up rotations from
+        # the watch without restart (certs.go:119-181 behaviorally)
+        docs.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Secret",
+                "metadata": {"name": v["certSecret"], "namespace": ns},
+                "type": "Opaque",
+            }
+        )
+        # HA is only real if voluntary disruption cannot drain every
+        # webhook replica at once
+        docs.append(
+            {
+                "apiVersion": "policy/v1",
+                "kind": "PodDisruptionBudget",
+                "metadata": {
+                    "name": "gatekeeper-webhook-pdb",
+                    "namespace": ns,
+                },
+                "spec": {
+                    "minAvailable": v["pdbMinAvailable"],
+                    "selector": {
+                        "matchLabels": {
+                            "gatekeeper.sh/operation": "webhook"
+                        }
+                    },
+                },
+            }
+        )
 
     webhook_args = [
         "--operation=webhook",
@@ -331,6 +377,11 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
         f"--health-addr-port={v['healthPort']}",
         f"--prometheus-port={v['prometheusPort']}",
     ]
+    if v["certSecret"]:
+        # Secret-backed shared cert store; certs are fetched/offered
+        # through the API, no pod-local cert volume remains
+        webhook_args.append(f"--cert-secret={v['certSecret']}")
+        webhook_args.append(f"--fleet-namespace={ns}")
     if v["logDenies"]:
         webhook_args.append("--log-denies")
     if v["emitAdmissionEvents"]:
@@ -348,18 +399,25 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
         "periodSeconds": 5,
         "failureThreshold": 12,
     }
+    # the Secret-backed store needs NO cert volume: artifacts flow
+    # through the API and the rotator caches them in a process-private
+    # temp dir. The pod-local emptyDir path survives only for the
+    # explicit certSecret="" opt-out (single-replica debugging).
     webhook_ctr["volumeMounts"] = [
-        {"name": "certs", "mountPath": "/certs"},
         {"name": "xla-cache", "mountPath": "/cache"},
     ]
+    webhook_vols = [_cache_volume(v)]
+    if not v["certSecret"]:
+        webhook_ctr["volumeMounts"].insert(
+            0, {"name": "certs", "mountPath": "/certs"}
+        )
+        webhook_ctr["args"].append("--cert-dir=/certs")
+        webhook_vols.insert(0, {"name": "certs", "emptyDir": {}})
     if v["resources"]:
         webhook_ctr["resources"] = v["resources"]
     webhook_pod: Dict[str, Any] = {
         "containers": [webhook_ctr],
-        "volumes": [
-            {"name": "certs", "emptyDir": {}},
-            _cache_volume(v),
-        ],
+        "volumes": webhook_vols,
     }
     if v["nodeSelector"]:
         webhook_pod["nodeSelector"] = v["nodeSelector"]
@@ -514,11 +572,13 @@ def render(values: Dict[str, Any] | None = None) -> List[Dict[str, Any]]:
 
 HEADER = """\
 # GENERATED by deploy/render.py — edit values there, not this file.
-# The operations-split deployment (webhook CPU replicas + one audit pod
-# on a TPU node), scoped RBAC, base CRDs (incl. the mutation kinds),
-# Service, and the fail-open Validating + Mutating webhook
-# configurations (shared namespace exclusions). See deploy/render.py's
-# docstring for the design rationale and charts/gatekeeper parity notes.
+# The operations-split deployment (3 HA webhook CPU replicas with the
+# Secret-backed fleet cert store + PodDisruptionBudget, one audit pod
+# on a TPU node), scoped RBAC, base CRDs (incl. the mutation kinds and
+# the FleetState gossip plane), Service, and the fail-open Validating +
+# Mutating webhook configurations (shared namespace exclusions). See
+# deploy/render.py's docstring for the design rationale and
+# charts/gatekeeper parity notes; docs/fleet.md for the fleet plane.
 """
 
 

@@ -37,7 +37,17 @@ import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..logs import null_logger
-from .events import ADDED, DELETED, MODIFIED, Event, EventSink, EventSource, GVK, obj_key
+from .events import (
+    ADDED,
+    Conflict,
+    DELETED,
+    Event,
+    EventSink,
+    EventSource,
+    GVK,
+    MODIFIED,
+    obj_key,
+)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -323,6 +333,21 @@ class KubeCluster(EventSource):
         return self._collection_path(
             GVK.from_obj(obj), meta.get("namespace") or ""
         )
+
+    def create(self, obj: Dict[str, Any]) -> None:
+        """Create-ONLY write: POST, with the apiserver's 409 surfaced as
+        `events.Conflict` instead of retried into a replace. The fleet
+        cert store's load-or-create depends on losing this race loudly —
+        the loser adopts the winner's Secret rather than clobbering it
+        (certs.go:119-181's CreateOrUpdate-with-conflict posture)."""
+        coll = self._obj_path(obj)
+        try:
+            self._request("POST", coll, body=obj)
+        except KubeError as e:
+            if e.code == 409:
+                name = (obj.get("metadata") or {}).get("name", "")
+                raise Conflict(f"{coll}/{name} already exists") from e
+            raise
 
     def apply(self, obj: Dict[str, Any]) -> None:
         """Create-or-replace (the status plane's write-with-retry,

@@ -77,6 +77,11 @@ class ReadinessTracker:
         self._lock = threading.Lock()
         self._constraints: Dict[str, ObjectTracker] = {}
         self._data: Dict[str, ObjectTracker] = {}
+        # named subsystem trackers (lazy — only gate readiness once
+        # requested): the fleet plane registers under "fleet" so a
+        # replica is not Ready before the shared cert store resolved
+        # and the state plane synced (docs/fleet.md)
+        self._components: Dict[str, ObjectTracker] = {}
 
     def for_constraint_kind(self, kind: str) -> ObjectTracker:
         with self._lock:
@@ -92,12 +97,20 @@ class ReadinessTracker:
                 t = self._data[gvk] = ObjectTracker()
             return t
 
+    def for_component(self, name: str) -> ObjectTracker:
+        with self._lock:
+            t = self._components.get(name)
+            if t is None:
+                t = self._components[name] = ObjectTracker()
+            return t
+
     def satisfied(self) -> bool:
         with self._lock:
             trackers = (
                 [self.templates, self.config]
                 + list(self._constraints.values())
                 + list(self._data.values())
+                + list(self._components.values())
             )
         return all(t.satisfied() for t in trackers)
 
@@ -111,4 +124,6 @@ class ReadinessTracker:
                 out[f"constraint/{k}"] = t.stats()
             for k, t in self._data.items():
                 out[f"data/{k}"] = t.stats()
+            for k, t in self._components.items():
+                out[f"component/{k}"] = t.stats()
         return out

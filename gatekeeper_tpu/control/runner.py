@@ -98,6 +98,20 @@ class Runner:
         # mirrors webhook.server.DEFAULT_MAX_QUEUE)
         fail_policy: str = "open",
         max_queue=2048,
+        # fleet plane (docs/fleet.md): CR-backed gossip making the
+        # external-data cache and breaker trips fleet properties.
+        # True builds a FleetPlane keyed by pod_name; pass an existing
+        # FleetPlane to share one across in-process replicas in tests;
+        # False disables (pure per-process state)
+        fleet=True,
+        # name of the Secret backing the SHARED cert store (the
+        # reference's mounted cert Secret, certs.go:119-181): replicas
+        # load-or-create one CA and pick up rotation via watch without
+        # restart. None = pod-local CertRotator in cert_dir (single
+        # replica / hermetic tests). Needs webhook_tls.
+        cert_secret: Optional[str] = None,
+        # namespace holding the cert Secret and FleetState CRs
+        fleet_namespace: str = "gatekeeper-system",
     ):
         from ..logs import null_logger
         from ..obs import Tracer
@@ -107,6 +121,7 @@ class Runner:
         self.cluster = cluster
         self.client = client
         self.target = target
+        self.pod_name = pod_name
         self.operations = set(operations)
         self.log_denies = log_denies
         self.log = logger if logger is not None else null_logger()
@@ -148,10 +163,27 @@ class Runner:
         self.webhook_tls = webhook_tls
         self.vwh_name = vwh_name
         self.cert_dir = cert_dir
+        self.cert_secret = cert_secret
+        self.fleet_namespace = fleet_namespace
         self.bind_addr = bind_addr
         self.ca_injector = None
         self.webhook = None
         self.audit = None
+        # fleet state plane (docs/fleet.md): built here so the
+        # external-data system below can attach before any provider
+        # ingests; started (watch + first publish) in start()
+        from ..fleet import FleetPlane
+
+        if fleet is True:
+            self.fleet = FleetPlane(
+                cluster,
+                replica_id=pod_name,
+                namespace=fleet_namespace,
+                metrics=metrics,
+                logger=self.log.with_values(process="fleet"),
+            )
+        else:
+            self.fleet = fleet or None
         self._readyz_httpd: Optional[ThreadingHTTPServer] = None
         from ..webhook.policy import TraceConfig
 
@@ -239,6 +271,10 @@ class Runner:
         self.external_data = ExternalDataSystem(
             metrics=metrics, tracer=self.tracer, logger=self.log
         )
+        if self.fleet is not None:
+            # cache entries publish to peers; per-provider breakers
+            # gossip as providers ingest (docs/fleet.md)
+            self.fleet.attach_cache(self.external_data)
         set_ed = getattr(client, "set_external_data", None)
         if set_ed is not None:
             set_ed(self.external_data)
@@ -343,6 +379,17 @@ class Runner:
 
         self._populate_expectations()
 
+        if self.fleet is not None:
+            # readiness: the state plane must have listed peers and
+            # offered its first publish before the replica reports
+            # Ready (start() below is synchronous; publish failures on
+            # a cluster without the CRD degrade, never block)
+            comp = self.tracker.for_component("fleet")
+            comp.expect("state-plane")
+            comp.expectations_done()
+            self.fleet.start()
+            comp.observe("state-plane")
+
         # watch registration order mirrors setupControllers: templates
         # first (they create constraint kinds), then config (it swaps the
         # sync watches), status kinds for the aggregator
@@ -362,6 +409,33 @@ class Runner:
             # when the client was built with the agent target
             # registered (docs/targets.md)
             from ..agentaction import TARGET_NAME as _AGENT_TARGET
+
+            rotator = None
+            if self.webhook_tls and self.cert_secret:
+                # the Secret-backed shared cert store: one CA per
+                # fleet, rotation picked up by peers without restart
+                # (docs/fleet.md; certs.go:119-181 behaviorally)
+                import tempfile
+
+                from ..fleet import FleetCertRotator, SecretCertStore
+
+                store = SecretCertStore(
+                    self.cluster,
+                    name=self.cert_secret,
+                    namespace=self.fleet_namespace,
+                    replica_id=self.pod_name,
+                    metrics=self.metrics,
+                    logger=self.log.with_values(process="fleet"),
+                )
+                rotator = FleetCertRotator(
+                    self.cert_dir
+                    or tempfile.mkdtemp(prefix="gk-certs-"),
+                    store,
+                    metrics=self.metrics,
+                    logger=self.log.with_values(process="fleet"),
+                )
+                rotator.ensure()  # load-or-create BEFORE serving
+                rotator.start()  # watch for peer rotations
 
             self.webhook = WebhookServer(
                 self.client,
@@ -383,11 +457,25 @@ class Runner:
                 tracer=self.tracer,
                 mutation_system=self.mutation_system,
                 cert_dir=self.cert_dir,
+                rotator=rotator,
                 bind_addr=self.bind_addr,
                 fail_policy=self.fail_policy,
                 max_queue=self.max_queue,
             )
             self.webhook.start()
+            if self.fleet is not None:
+                # device-breaker trips gossip: an outage one replica
+                # discovered pre-opens peers' breakers to a half-open
+                # probe instead of N independent rediscoveries
+                for plane_name, batcher in (
+                    ("device:validation", self.webhook.batcher),
+                    ("device:mutation", self.webhook.mutate_batcher),
+                    ("device:agent", self.webhook.agent_batcher),
+                ):
+                    if batcher is not None and batcher.breaker is not None:
+                        self.fleet.register_breaker(
+                            plane_name, batcher.breaker
+                        )
             if self.vwh_name and self.webhook.rotator is not None:
                 from ..webhook.certs import CaBundleInjector
 
@@ -595,9 +683,14 @@ class Runner:
         self._event_wake.set()
         if self.ca_injector is not None:
             self.ca_injector.stop()
+        if self.fleet is not None:
+            self.fleet.stop()
         if self.audit is not None:
             self.audit.stop()
         if self.webhook is not None:
+            rot_stop = getattr(self.webhook.rotator, "stop", None)
+            if rot_stop is not None:
+                rot_stop()  # fleet rotator: unsubscribe the Secret watch
             self.webhook.stop()
         if self._readyz_httpd is not None:
             self._readyz_httpd.shutdown()
@@ -700,6 +793,19 @@ class Runner:
                         stats["externaldata"] = (
                             runner.external_data.snapshot()
                         )
+                    if runner.fleet is not None:
+                        # fleet health (docs/fleet.md): which peers are
+                        # alive, what state arrived from them, and the
+                        # cert generation this replica serves
+                        fl = runner.fleet.snapshot()
+                        rot = getattr(runner.webhook, "rotator", None)
+                        fl["cert_generation"] = getattr(
+                            rot, "cert_generation", None
+                        )
+                        fl["cert_rotations_adopted"] = getattr(
+                            rot, "rotations_adopted", None
+                        )
+                        stats["fleet"] = fl
                     drv = getattr(runner.client, "_driver", None)
                     if drv is not None and hasattr(drv, "stats"):
                         # engine routing health (docs/metrics.md): WHY

@@ -69,6 +69,12 @@ class CircuitBreaker:
         self._opened_at: Optional[float] = None
         self._probe_in_flight = False
         self.transitions = 0  # lifetime transition count (tests/readyz)
+        self.adoptions = 0  # peer states adopted via adopt() (readyz)
+        # transition listeners (the fleet plane's gossip hook). Called
+        # INSIDE the breaker lock — listeners must be non-blocking and
+        # must never call back into the breaker (set a flag / wake an
+        # event; the fleet publisher drains asynchronously).
+        self._listeners = []
         self._export_state()
 
     # -- state ---------------------------------------------------------------
@@ -87,6 +93,7 @@ class CircuitBreaker:
                 "state": self._state,
                 "consecutive_failures": self._consecutive_failures,
                 "transitions": self.transitions,
+                "adoptions": self.adoptions,
                 "probe_in_flight": self._probe_in_flight,
             }
 
@@ -114,6 +121,11 @@ class CircuitBreaker:
             self._opened_at = None
             self._probe_in_flight = False
         self._export_state()
+        for listener in self._listeners:
+            try:
+                listener(from_state, to_state)
+            except Exception:
+                pass  # gossip is best-effort; the breaker must not die
         if self.metrics is not None:
             self.metrics.record(
                 "device_breaker_transitions_total", 1, plane=self.plane,
@@ -134,6 +146,39 @@ class CircuitBreaker:
                 "device_breaker_state", _STATE_VALUE[self._state],
                 plane=self.plane,
             )
+
+    # -- fleet gossip ---------------------------------------------------------
+
+    def subscribe(self, listener) -> None:
+        """Register a `listener(from_state, to_state)` transition hook
+        (the fleet plane's publish trigger). Called inside the breaker
+        lock: must be non-blocking and must not re-enter the breaker."""
+        with self._lock:
+            self._listeners.append(listener)
+
+    def adopt(self, peer_state: str) -> bool:
+        """Adopt a peer replica's breaker verdict (docs/fleet.md):
+
+          * peer OPEN/HALF_OPEN while we are CLOSED → pre-open to
+            HALF_OPEN: the next batch is a single probe instead of
+            `failure_threshold` full batches rediscovering the outage;
+          * peer CLOSED while we are OPEN → HALF_OPEN early: the peer's
+            success is evidence recovery happened, probe now rather
+            than waiting out the local recovery window.
+
+        Never adopts straight to OPEN — a peer's outage is a hint, not
+        proof, for THIS replica's device/endpoint; the probe decides.
+        Returns True when a transition happened."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if peer_state in (OPEN, HALF_OPEN) and self._state == CLOSED:
+                self._transition_locked(HALF_OPEN)
+            elif peer_state == CLOSED and self._state == OPEN:
+                self._transition_locked(HALF_OPEN)
+            else:
+                return False
+            self.adoptions += 1
+            return True
 
     # -- the contract --------------------------------------------------------
 

@@ -16,7 +16,14 @@ with the selected operations; flags mirror the reference's surface:
   --log-denies           structured deny logs (policy.go:73)
   --emit-admission-events / --emit-audit-events
   --exempt-namespace     ns-label webhook exemption (repeatable)
-  --cert-dir             TLS artifacts dir (rotated self-signed pair)
+  --cert-dir             local TLS artifact cache dir ("" = private
+                         temp dir; with --cert-secret this is ONLY a
+                         cache — the Secret is the store)
+  --cert-secret          name of the Secret backing the SHARED fleet
+                         cert store (docs/fleet.md; "" = pod-local
+                         certs, single-replica only)
+  --fleet-namespace      namespace holding the cert Secret + FleetState
+                         CRs (the gossip plane for cache/breaker state)
   --vwh-name             ValidatingWebhookConfiguration to keep
                          injected with the rotating CA bundle
   --enable-pprof         JAX profiler endpoint on the health server
@@ -56,7 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--emit-admission-events", action="store_true")
     p.add_argument("--emit-audit-events", action="store_true")
     p.add_argument("--exempt-namespace", action="append", default=[])
-    p.add_argument("--cert-dir", default="/certs")
+    p.add_argument("--cert-dir", default="")
+    # the fleet plane (docs/fleet.md): Secret-backed shared certs ON by
+    # default — HA replicas must serve one CA; opt out with ""
+    p.add_argument(
+        "--cert-secret", default="gatekeeper-webhook-server-cert"
+    )
+    p.add_argument("--fleet-namespace", default="gatekeeper-system")
     p.add_argument("--vwh-name", default="")
     p.add_argument("--enable-pprof", action="store_true")
     # overload/degradation envelope (docs/robustness.md): the response
@@ -124,7 +137,11 @@ def build_runner(args, log=None, webhook_tls: bool = True):
         log_denies=args.log_denies,
         logger=log,
         vwh_name=args.vwh_name or None,
-        cert_dir=args.cert_dir,
+        cert_dir=args.cert_dir or None,  # "" = process-private temp dir
+        cert_secret=getattr(args, "cert_secret", "") or None,
+        fleet_namespace=getattr(
+            args, "fleet_namespace", "gatekeeper-system"
+        ),
         fail_policy=getattr(args, "fail_policy", "open"),
         max_queue=(
             getattr(args, "max_queue", 2048) or None

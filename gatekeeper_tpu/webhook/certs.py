@@ -93,23 +93,52 @@ class CertRotator:
         return exp <= lookahead
 
     @staticmethod
-    def _cert_expiry(path: str) -> Optional[datetime.datetime]:
-        from cryptography import x509
+    def pem_expiry(data: bytes) -> Optional[datetime.datetime]:
+        """not_valid_after of the first certificate in a PEM blob, or
+        None when unparseable (treated as needs-refresh). Prefers the
+        `cryptography` package; containers without it (the bench image)
+        fall back to the `openssl` binary."""
+        try:
+            from cryptography import x509
 
+            cert = x509.load_pem_x509_certificate(data)
+            return cert.not_valid_after_utc
+        except ImportError:
+            return _openssl_expiry(data)
+        except Exception:
+            return None
+
+    @classmethod
+    def _cert_expiry(cls, path: str) -> Optional[datetime.datetime]:
         try:
             with open(path, "rb") as f:
-                cert = x509.load_pem_x509_certificate(f.read())
-            return cert.not_valid_after_utc
+                return cls.pem_expiry(f.read())
         except Exception:
             return None
 
     def _refresh(self) -> None:
+        self.install_artifacts(self.generate_pair())
+        self.rotations += 1
+
+    def generate_pair(self) -> dict:
+        """Generate a fresh CA + server pair; returns the PEM artifacts
+        as {"ca.crt": bytes, "tls.crt": bytes, "tls.key": bytes} WITHOUT
+        touching disk — the fleet cert store offers this dict to the
+        shared Secret before any replica serves it. Prefers the
+        `cryptography` package; falls back to the `openssl` binary so
+        TLS serving works in containers without the wheel."""
+        try:
+            from cryptography import x509  # noqa: F401
+        except ImportError:
+            return _openssl_generate_pair(self.dns_name)
+        return self._generate_pair_cryptography()
+
+    def _generate_pair_cryptography(self) -> dict:
         from cryptography import x509
         from cryptography.hazmat.primitives import hashes, serialization
         from cryptography.hazmat.primitives.asymmetric import rsa
         from cryptography.x509.oid import NameOID
 
-        os.makedirs(self.cert_dir, exist_ok=True)
         now = self._now()
 
         # CA (certs.go:265-301)
@@ -181,22 +210,137 @@ class CertRotator:
         )
 
         pem = serialization.Encoding.PEM
-        with open(self.ca_path, "wb") as f:
-            f.write(ca_cert.public_bytes(pem))
-        with open(self.cert_path, "wb") as f:
-            f.write(srv_cert.public_bytes(pem))
+        return {
+            "ca.crt": ca_cert.public_bytes(pem),
             # chain the CA so clients can verify with just tls.crt
-            f.write(ca_cert.public_bytes(pem))
-        with open(self.key_path, "wb") as f:
+            "tls.crt": srv_cert.public_bytes(pem)
+            + ca_cert.public_bytes(pem),
+            "tls.key": srv_key.private_bytes(
+                pem,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            ),
+        }
+
+    def install_artifacts(self, artifacts: dict) -> None:
+        """Write the ca.crt / tls.crt / tls.key triple via
+        write-then-atomic-rename. With multiple replicas two concurrent
+        `ensure()` callers are real, and a reader racing an in-place
+        rewrite could load a tls.crt signed by a ca.crt it hasn't seen —
+        a torn pair. os.rename within one directory is atomic, so every
+        reader sees either the old artifact or the new one, never a
+        partial write; the key lands BEFORE the certs so no reader can
+        observe a cert whose key is still the old one's."""
+        os.makedirs(self.cert_dir, exist_ok=True)
+        for fname, path, mode in (
+            ("tls.key", self.key_path, 0o600),
+            ("ca.crt", self.ca_path, None),
+            ("tls.crt", self.cert_path, None),
+        ):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(artifacts[fname])
+            if mode is not None:
+                os.chmod(tmp, mode)
+            os.rename(tmp, path)
+
+
+# -- openssl-CLI fallback ----------------------------------------------------
+# The jax_graft container ships no `cryptography` wheel; the TLS plane
+# must not become import-poisoned there (and nothing may be pip
+# installed), so the same CA/server-pair semantics are reproduced with
+# the `openssl` binary. Same validity windows, same SANs, same chained
+# tls.crt output.
+
+
+def _openssl_expiry(data: bytes) -> Optional[datetime.datetime]:
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["openssl", "x509", "-noout", "-enddate"],
+            input=data, capture_output=True, timeout=30, check=True,
+        ).stdout.decode()
+    except Exception:
+        return None
+    # "notAfter=Jan  1 12:00:00 2036 GMT"
+    _, _, stamp = out.strip().partition("=")
+    stamp = stamp.replace(" GMT", "").strip()
+    try:
+        dt = datetime.datetime.strptime(stamp, "%b %d %H:%M:%S %Y")
+    except ValueError:
+        return None
+    return dt.replace(tzinfo=datetime.timezone.utc)
+
+
+def _openssl_generate_pair(dns_name: str) -> dict:
+    import subprocess
+    import tempfile
+
+    def run(*args, **kw):
+        subprocess.run(
+            list(args), capture_output=True, timeout=120, check=True, **kw
+        )
+
+    with tempfile.TemporaryDirectory(prefix="gk-certgen-") as d:
+        ca_key, ca_crt = f"{d}/ca.key", f"{d}/ca.crt"
+        srv_key, srv_csr, srv_crt = (
+            f"{d}/tls.key", f"{d}/srv.csr", f"{d}/srv.crt"
+        )
+        # an explicit -config (not -addext): the system openssl.cnf adds
+        # its own default basicConstraints, and a certificate with the
+        # extension twice fails chain verification
+        ca_cnf = f"{d}/ca.cnf"
+        with open(ca_cnf, "w") as f:
             f.write(
-                srv_key.private_bytes(
-                    pem,
-                    serialization.PrivateFormat.TraditionalOpenSSL,
-                    serialization.NoEncryption(),
-                )
+                "[req]\n"
+                "distinguished_name=dn\n"
+                "x509_extensions=v3_ca\n"
+                "prompt=no\n"
+                f"[dn]\nCN={CA_NAME}\n"
+                "[v3_ca]\n"
+                "basicConstraints=critical,CA:TRUE\n"
+                "keyUsage=critical,digitalSignature,keyCertSign,cRLSign\n"
+                "subjectKeyIdentifier=hash\n"
             )
-        os.chmod(self.key_path, 0o600)
-        self.rotations += 1
+        run(
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", ca_key, "-out", ca_crt,
+            "-days", str(CA_VALIDITY_DAYS), "-config", ca_cnf,
+        )
+        run(
+            "openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", srv_key, "-out", srv_csr,
+            "-subj", f"/CN={dns_name}",
+        )
+        sans = [f"DNS:{dns_name}"]
+        if dns_name != "localhost":
+            sans.append("DNS:localhost")
+        sans.append("IP:127.0.0.1")
+        ext = f"{d}/ext.cnf"
+        with open(ext, "w") as f:
+            f.write(
+                f"subjectAltName={','.join(sans)}\n"
+                "extendedKeyUsage=serverAuth\n"
+            )
+        run(
+            "openssl", "x509", "-req", "-in", srv_csr,
+            "-CA", ca_crt, "-CAkey", ca_key, "-CAcreateserial",
+            "-days", str(CERT_VALIDITY_DAYS), "-out", srv_crt,
+            "-extfile", ext,
+        )
+
+        def read(p: str) -> bytes:
+            with open(p, "rb") as f:
+                return f.read()
+
+        ca_pem = read(ca_crt)
+        return {
+            "ca.crt": ca_pem,
+            # chain the CA so clients can verify with just tls.crt
+            "tls.crt": read(srv_crt) + ca_pem,
+            "tls.key": read(srv_key),
+        }
 
 
 VWH_GVK_ARGS = ("admissionregistration.k8s.io", "v1",
@@ -223,6 +367,12 @@ class CaBundleInjector:
     def start(self) -> None:
         self.inject()
         self._unsubscribe = self.cluster.subscribe(self.gvk, self._on_event)
+        # fleet rotators announce rotations (their own AND peers' picked
+        # up from the shared Secret): re-inject immediately instead of
+        # waiting for VWH drift to be noticed
+        on_rotate = getattr(self.rotator, "on_rotate", None)
+        if on_rotate is not None:
+            on_rotate(lambda *_a, **_k: self.inject())
 
     def stop(self) -> None:
         if self._unsubscribe is not None:

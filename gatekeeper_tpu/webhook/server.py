@@ -484,6 +484,13 @@ class WebhookServer:
         metrics=None,
         tls: bool = False,
         cert_dir: Optional[str] = None,
+        # pre-built cert rotator (fleet.FleetCertRotator for the
+        # Secret-backed shared store); None builds a pod-local
+        # CertRotator in cert_dir. When the rotator exposes on_rotate
+        # (the fleet one does), a rotation — our own OR a peer's —
+        # re-loads the live SSL context so new handshakes serve the new
+        # pair WITHOUT a restart.
+        rotator=None,
         request_timeout: float = DEFAULT_REQUEST_TIMEOUT,
         trace_config=None,
         event_sink=None,
@@ -636,26 +643,48 @@ class WebhookServer:
             daemon_threads = True
 
         self._httpd = _Server((bind_addr, port), _Handler)
-        self.rotator = None
+        self.rotator = rotator
+        self._ssl_ctx = None
         if tls:
             import ssl
             import tempfile
 
             from .certs import CertRotator
 
-            if cert_dir is None:
-                cert_dir = tempfile.mkdtemp(prefix="gk-certs-")
-            self.rotator = CertRotator(cert_dir)
+            if self.rotator is None:
+                if cert_dir is None:
+                    cert_dir = tempfile.mkdtemp(prefix="gk-certs-")
+                self.rotator = CertRotator(cert_dir)
             cert_path, key_path = self.rotator.ensure()  # CertsMounted gate
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(cert_path, key_path)
+            self._ssl_ctx = ctx
             self._httpd.socket = ctx.wrap_socket(
                 self._httpd.socket, server_side=True
             )
+            # rotation pickup without restart: SSLContext is live — a
+            # re-load swaps the pair for every handshake AFTER this
+            # point while established connections finish on the old one
+            on_rotate = getattr(self.rotator, "on_rotate", None)
+            if on_rotate is not None:
+                on_rotate(self._reload_tls)
         self.scheme = "https" if tls else "http"
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
         self.warm = False
+
+    def _reload_tls(self) -> None:
+        if self._ssl_ctx is None or self.rotator is None:
+            return
+        try:
+            self._ssl_ctx.load_cert_chain(
+                self.rotator.cert_path, self.rotator.key_path
+            )
+        except Exception:
+            # a torn read is impossible (atomic-rename installs), but a
+            # rotation racing deletion must not kill serving — the old
+            # pair keeps serving until the next successful reload
+            pass
 
     def start(self) -> None:
         self.batcher.start()

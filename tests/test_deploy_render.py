@@ -41,8 +41,8 @@ def test_default_render_shape():
     docs = render.render()
     ks = kinds(docs)
     # base CRDs + MutatorPodStatus + Assign/AssignMetadata/ModifySet
-    # + ProviderPodStatus + the external-data Provider CRD
-    assert ks.count("CustomResourceDefinition") == 10
+    # + ProviderPodStatus + the external-data Provider CRD + FleetState
+    assert ks.count("CustomResourceDefinition") == 11
     for k in (
         "Namespace",
         "ServiceAccount",
@@ -51,6 +51,8 @@ def test_default_render_shape():
         "Service",
         "ValidatingWebhookConfiguration",
         "MutatingWebhookConfiguration",
+        "Secret",
+        "PodDisruptionBudget",
     ):
         assert ks.count(k) == 1, k
     assert ks.count("Deployment") == 2
@@ -102,6 +104,60 @@ def test_default_render_shape():
         mutate["namespaceSelector"]
         == admit["validation.gatekeeper.sh"]["namespaceSelector"]
     )
+
+
+def test_fleet_defaults():
+    """HA by default (docs/fleet.md): 3 webhook replicas sharing the
+    Secret-backed cert store, a PDB so voluntary disruption cannot
+    drain the plane, the FleetState gossip CRD + RBAC, and NO pod-local
+    cert volume left on the default path."""
+    docs = render.render()
+    deps = {d["metadata"]["name"]: d for d in by_kind(docs, "Deployment")}
+    web = deps["gatekeeper-webhook"]
+    assert web["spec"]["replicas"] == 3
+    pod = web["spec"]["template"]["spec"]
+    args = pod["containers"][0]["args"]
+    assert "--cert-secret=gatekeeper-webhook-server-cert" in args
+    # no pod-local-disk cert path remains on the default path
+    assert not any(v["name"] == "certs" for v in pod["volumes"])
+    assert not any(a.startswith("--cert-dir") for a in args)
+    # the shipped Secret is the EMPTY placeholder the first replica
+    # populates (load-or-create)
+    sec = by_kind(docs, "Secret")[0]
+    assert sec["metadata"]["name"] == "gatekeeper-webhook-server-cert"
+    assert not sec.get("data")
+    pdb = by_kind(docs, "PodDisruptionBudget")[0]
+    assert pdb["spec"]["minAvailable"] == 1
+    assert (
+        pdb["spec"]["selector"]["matchLabels"]
+        == web["spec"]["selector"]["matchLabels"]
+    )
+    crds = {
+        d["metadata"]["name"]
+        for d in by_kind(docs, "CustomResourceDefinition")
+    }
+    assert "fleetstates.fleet.gatekeeper.sh" in crds
+    role = by_kind(docs, "ClusterRole")[0]
+    gk_rule = next(
+        r for r in role["rules"]
+        if "fleet.gatekeeper.sh" in r.get("apiGroups", [])
+    )
+    assert "create" in gk_rule["verbs"]
+
+    # --set replicas=N still works; the "" opt-out restores the
+    # pod-local cert path for single-replica debugging
+    n5 = render.render({"replicas": 5})
+    assert {
+        d["metadata"]["name"]: d for d in by_kind(n5, "Deployment")
+    }["gatekeeper-webhook"]["spec"]["replicas"] == 5
+    off = render.render({"certSecret": ""})
+    assert not by_kind(off, "Secret")
+    assert not by_kind(off, "PodDisruptionBudget")
+    opod = {
+        d["metadata"]["name"]: d for d in by_kind(off, "Deployment")
+    }["gatekeeper-webhook"]["spec"]["template"]["spec"]
+    assert any(v["name"] == "certs" for v in opod["volumes"])
+    assert "--cert-dir=/certs" in opod["containers"][0]["args"]
 
 
 def test_mutation_crds_and_disable():
