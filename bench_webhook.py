@@ -1206,10 +1206,52 @@ def _summarize(mode, res):
     return "SUMMARY: " + json.dumps(head, default=str)
 
 
+def run_soak_bench(argv, err=sys.stderr):
+    """The `--soak` lane (docs/operations.md §Soak runbook): minutes of
+    open-loop Poisson load with a declarative churn/fault/kill timeline,
+    reported as SLO attainment, shed rate, breaker transitions, the
+    device-time split, a capacity model, and leak evidence.
+
+        python bench_webhook.py --soak                    # full default
+        python bench_webhook.py --soak --smoke            # ~10 s smoke
+        python bench_webhook.py --soak --scenario f.json  # custom
+        python bench_webhook.py --soak 120 80             # duration rps
+    """
+    from gatekeeper_tpu.soak import (
+        default_scenario,
+        load_scenario,
+        run_soak,
+        smoke_scenario,
+    )
+
+    if "--scenario" in argv:
+        path = argv[argv.index("--scenario") + 1]
+        scn = load_scenario(path)
+    elif "--smoke" in argv:
+        scn = smoke_scenario()
+    else:
+        scn = default_scenario()
+        pos = [a for a in argv[1:] if not a.startswith("--")]
+        if pos:
+            scn.duration_s = float(pos[0])
+        if len(pos) > 1:
+            scn.rps = float(pos[1])
+        scn.validate()
+    print(f"soak scenario: {scn.name} duration={scn.duration_s}s "
+          f"rps={scn.rps} replicas={scn.replicas}", file=err)
+    return run_soak(scn, err=err)
+
+
 if __name__ == "__main__":
     import json
 
-    if "--ladder" in sys.argv:
+    if "--soak" in sys.argv:
+        from gatekeeper_tpu.soak import summarize_soak
+
+        res = run_soak_bench(sys.argv)
+        print(json.dumps(res))
+        print(summarize_soak(res))
+    elif "--ladder" in sys.argv:
         rows, skipped = run_constraint_ladder()
         res = {"rungs": rows, "skipped": skipped}
         print(json.dumps(res))

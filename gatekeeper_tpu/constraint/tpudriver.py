@@ -239,6 +239,14 @@ class TpuDriver(RegoDriver):
         self._render_cache: Dict[
             str, Tuple[Tuple[int, int], Dict[Tuple[int, int], List[Result]]]
         ] = {}
+        # render-cache bound (docs/robustness.md §soak): within one
+        # (data, constraint) generation the pair space is corpus x
+        # constraints — a huge synced corpus under sustained audit must
+        # evict oldest-cached pairs, never grow without bound
+        self.render_cache_max = int(
+            _os.environ.get("GATEKEEPER_TPU_RENDER_CACHE_MAX", "65536")
+        )
+        self._render_cache_evictions = 0
         # instrumentation for tests/bench: compiled-path pair evaluations
         # vs interpreter fallback evaluations in the last query
         self.stats: Dict[str, int] = {}
@@ -411,6 +419,24 @@ class TpuDriver(RegoDriver):
         _render_errors, incremented at the same sites."""
         if self.metrics is not None:
             self.metrics.record(name, value, **tags)
+
+    def _render_cache_put(
+        self, cache: Dict[Tuple[int, int], List[Result]],
+        key: Tuple[int, int], results: List[Result],
+    ) -> None:
+        """Bounded insert into a per-target rendered-pair cache:
+        oldest-cached pair evicted (dict insertion order) when the
+        bound is hit, counted so a soak's leak check can distinguish a
+        bounded churning cache from a growing one."""
+        if len(cache) >= self.render_cache_max:
+            cache.pop(next(iter(cache)), None)
+            self._render_cache_evictions += 1
+            self._count("driver_render_cache_evictions_total")
+        cache[key] = results
+
+    def render_cache_size(self) -> int:
+        """Total cached rendered pairs across targets (soak sampling)."""
+        return sum(len(c[1]) for c in self._render_cache.values())
 
     def _program_for(
         self, target: str, constraint: Dict[str, Any]
@@ -1519,7 +1545,9 @@ class TpuDriver(RegoDriver):
                             )
                         n_interp_render += 1
                     if render_cache is not None:
-                        render_cache[(n_i, c_i)] = out
+                        self._render_cache_put(
+                            render_cache, (n_i, c_i), out
+                        )
                 per_review[n_i].extend(out)
                 n_results += len(out)
             t_done = _time.perf_counter()
@@ -1543,6 +1571,7 @@ class TpuDriver(RegoDriver):
                 "interp_rendered_pairs": n_interp_render,
                 "pruned_renders": n_pruned,
                 "render_errors": self._render_errors,
+                "render_cache_evictions": self._render_cache_evictions,
                 "hot_redispatches": self._hot_redispatches,
                 "phase_seconds": phase_seconds,
                 # machine-readable WHY for every wholesale-interpreter

@@ -112,6 +112,10 @@ class Runner:
         cert_secret: Optional[str] = None,
         # namespace holding the cert Secret and FleetState CRs
         fleet_namespace: str = "gatekeeper-system",
+        # graceful drain (docs/robustness.md): seconds /readyz reports
+        # not-ready while the webhook listener still accepts, so the
+        # LB/kubelet routes away before connections start failing
+        drain_grace_s: float = 0.0,
     ):
         from ..logs import null_logger
         from ..obs import Tracer
@@ -159,6 +163,7 @@ class Runner:
         self.readyz_port = readyz_port
         self.fail_policy = fail_policy
         self.max_queue = max_queue
+        self.drain_grace_s = drain_grace_s
         self.exempt_namespaces = list(exempt_namespaces)
         self.webhook_tls = webhook_tls
         self.vwh_name = vwh_name
@@ -461,6 +466,7 @@ class Runner:
                 bind_addr=self.bind_addr,
                 fail_policy=self.fail_policy,
                 max_queue=self.max_queue,
+                drain_grace_s=self.drain_grace_s,
             )
             self.webhook.start()
             if self.fleet is not None:
@@ -674,6 +680,13 @@ class Runner:
         return True
 
     def stop(self) -> None:
+        # graceful drain FIRST: readiness flips not-ready while the
+        # webhook listener still accepts, so a probing LB routes away
+        # before any connection can fail (WebhookServer.stop then holds
+        # the drain grace, closes the listener, and waits for in-flight
+        # requests — a SIGTERM mid-load sheds zero accepted requests)
+        if self.webhook is not None:
+            self.webhook.begin_drain()
         # signal everything first, drain components, JOIN the warm
         # thread last — its join can ride out an in-flight XLA compile,
         # and serving must not keep running behind that wait
@@ -745,10 +758,20 @@ class Runner:
             def do_GET(self):  # noqa: N802
                 if self.path == "/readyz":
                     # Ready = state replayed (reference semantics); warm
-                    # status stays visible in stats but does not gate
-                    ok = ingested = runner.tracker.satisfied()
+                    # status stays visible in stats but does not gate.
+                    # A DRAINING webhook reports not-ready immediately —
+                    # the flip happens before its listener closes, so a
+                    # probing LB stops routing while connections still
+                    # succeed (docs/robustness.md graceful drain)
+                    ingested = runner.tracker.satisfied()
+                    draining = (
+                        runner.webhook is not None
+                        and runner.webhook.draining
+                    )
+                    ok = ingested and not draining
                     stats = {
                         "ingested": ingested,
+                        "draining": draining,
                         **runner.tracker.stats(),
                     }
                     if runner.audit is not None:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -57,19 +58,29 @@ class Entry:
 
 
 class ResponseCache:
-    """Per-(provider, key) entry store. Thread-safe; bounded per
-    provider (`max_entries`, LRU-ish eviction by fetched_at) so a
-    high-cardinality key space cannot grow memory without bound."""
+    """Per-(provider, key) entry store. Thread-safe; bounded
+    (`max_entries`) with true LRU eviction — reads refresh recency, so
+    a soak's hot key set survives while a high-cardinality cold tail is
+    what gets evicted; a run can never grow this map without bound.
+    Evictions are counted (`evictions`, and
+    `externaldata_cache_evictions_total` when metrics are wired) so a
+    leak check can tell "bounded and churning" from "growing"."""
 
     def __init__(
         self,
         clock: Callable[[], float] = time.monotonic,
         max_entries: int = 65536,
+        metrics=None,
     ):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
         self._clock = clock
         self.max_entries = max_entries
+        self.metrics = metrics
         self._lock = threading.Lock()
-        self._entries: Dict[Tuple[str, str], Entry] = {}
+        # ordered oldest-access-first: the LRU order
+        self._entries: "OrderedDict[Tuple[str, str], Entry]" = OrderedDict()
+        self.evictions = 0  # lifetime count (soak leak evidence)
         # bumped on every write: lets consumers key derived state (e.g.
         # precomputed row-feature bits) on cache content
         self.generation = 0
@@ -92,6 +103,9 @@ class ResponseCache:
                 if e is None:
                     out[k] = (MISS, None)
                 else:
+                    # LRU touch: a read of a live entry refreshes its
+                    # recency so the hot working set outlives cold tails
+                    self._entries.move_to_end((provider, k))
                     out[k] = (e.state(now), e)
         return out
 
@@ -114,18 +128,24 @@ class ResponseCache:
                 ttl=ttl,
                 stale_ttl=stale_ttl,
             )
+            self._entries.move_to_end((provider, key))
             self.generation += 1
             if len(self._entries) > self.max_entries:
                 self._evict_locked()
 
     def _evict_locked(self) -> None:
-        # drop the oldest 10%: eviction is rare (bounded key spaces in
-        # practice) so simplicity beats a true LRU list here
-        drop = max(1, len(self._entries) // 10)
-        for k in sorted(
-            self._entries, key=lambda k: self._entries[k].fetched_at
-        )[:drop]:
-            del self._entries[k]
+        # pop least-recently-used until back at the bound; counted per
+        # provider so an eviction storm names the key space causing it
+        by_provider: Dict[str, int] = {}
+        while len(self._entries) > self.max_entries:
+            (prov, _k), _e = self._entries.popitem(last=False)
+            self.evictions += 1
+            by_provider[prov] = by_provider.get(prov, 0) + 1
+        if self.metrics is not None:
+            for prov, n in by_provider.items():
+                self.metrics.record(
+                    "externaldata_cache_evictions_total", n, provider=prov
+                )
 
     # -- fleet sync (docs/fleet.md) ------------------------------------------
 
@@ -189,6 +209,7 @@ class ResponseCache:
                 stale_ttl=stale_ttl,
                 origin=origin,
             )
+            self._entries.move_to_end((provider, key))
             self.generation += 1
             if len(self._entries) > self.max_entries:
                 self._evict_locked()

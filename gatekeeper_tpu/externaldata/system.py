@@ -125,6 +125,9 @@ class ExternalDataSystem:
         clock: Callable[[], float] = time.monotonic,
         breaker_threshold: int = 3,
         breaker_recovery_s: float = 30.0,
+        # response-cache bound (LRU; docs/externaldata.md): a soak
+        # against a high-cardinality key space must evict, never grow
+        cache_max_entries: int = 65536,
     ):
         from ..logs import null_logger
 
@@ -135,7 +138,9 @@ class ExternalDataSystem:
         self._clock = clock
         self.breaker_threshold = breaker_threshold
         self.breaker_recovery_s = breaker_recovery_s
-        self.cache = ResponseCache(clock=clock)
+        self.cache = ResponseCache(
+            clock=clock, max_entries=cache_max_entries, metrics=metrics
+        )
         self._lock = threading.Lock()
         self._providers: Dict[str, Provider] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
@@ -531,6 +536,7 @@ class ExternalDataSystem:
                 for name, p in sorted(providers.items())
             },
             "cache_entries": len(self.cache),
+            "cache_evictions": self.cache.evictions,
             "fetches": self.fetch_count,
             "stale_serves": self.stale_serves,
         }

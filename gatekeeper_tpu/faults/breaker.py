@@ -159,19 +159,26 @@ class CircuitBreaker:
     def adopt(self, peer_state: str) -> bool:
         """Adopt a peer replica's breaker verdict (docs/fleet.md):
 
-          * peer OPEN/HALF_OPEN while we are CLOSED → pre-open to
-            HALF_OPEN: the next batch is a single probe instead of
+          * peer OPEN while we are CLOSED → pre-open to HALF_OPEN: the
+            next batch is a single probe instead of
             `failure_threshold` full batches rediscovering the outage;
           * peer CLOSED while we are OPEN → HALF_OPEN early: the peer's
             success is evidence recovery happened, probe now rather
             than waiting out the local recovery window.
+
+        A peer's HALF_OPEN is deliberately NOT adopted: it means the
+        peer is *probing*, not that an outage is confirmed — and
+        adopting it ping-pongs two recovered replicas between CLOSED
+        and HALF_OPEN forever (A closes, B adopts B's-recovery-induced
+        HALF_OPEN back, ...), which on a quiet plane never settles
+        (surfaced by the soak lane's breaker transition log).
 
         Never adopts straight to OPEN — a peer's outage is a hint, not
         proof, for THIS replica's device/endpoint; the probe decides.
         Returns True when a transition happened."""
         with self._lock:
             self._maybe_half_open_locked()
-            if peer_state in (OPEN, HALF_OPEN) and self._state == CLOSED:
+            if peer_state == OPEN and self._state == CLOSED:
                 self._transition_locked(HALF_OPEN)
             elif peer_state == CLOSED and self._state == OPEN:
                 self._transition_locked(HALF_OPEN)

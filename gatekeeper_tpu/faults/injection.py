@@ -131,6 +131,24 @@ class FaultRegistry:
     def active(self) -> bool:
         return self._active
 
+    def snapshot(self) -> Dict[str, Dict]:
+        """Armed-point inventory with hit/fire counts — the soak
+        reporter logs this at each disarm so the evidence artifact
+        carries WHICH faults fired and how often, not just that an SLO
+        dip happened around the right timestamp."""
+        with self._lock:
+            return {
+                point: {
+                    "mode": s.mode,
+                    "count": s.count,
+                    "after": s.after,
+                    "delay_s": s.delay_s,
+                    "hits": s.hits,
+                    "fired": s.fired,
+                }
+                for point, s in self._specs.items()
+            }
+
     # -- the fault point ----------------------------------------------------
 
     def fire(self, point: str) -> None:

@@ -139,6 +139,17 @@ class MetricsRegistry:
 
     # -- read ----------------------------------------------------------------
 
+    def series_count(self) -> int:
+        """Total live series (counter + gauge + distribution label
+        combinations). Label cardinality is the classic slow metrics
+        leak; the soak sampler watches this number per window so an
+        unbounded tag (a per-request id, a timestamp label) flags
+        instead of OOMing a three-day-old pod."""
+        with self._lock:
+            return (
+                len(self._counters) + len(self._gauges) + len(self._dists)
+            )
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {

@@ -283,6 +283,19 @@ class Tracer:
     def export_json(self, n: int = 50) -> str:
         return json.dumps({"traces": self.recent(n)})
 
+    def size(self) -> Dict[str, int]:
+        """Retention sizes (the soak leak sampler's view): completed
+        traces in the ring, still-active traces, and total retained
+        spans. The ring is bounded by construction — this exists so a
+        soak can PROVE it, not assume it."""
+        with self._lock:
+            return {
+                "ring": len(self._ring),
+                "active": len(self._active),
+                "spans": sum(len(t["spans"]) for t in self._ring)
+                + sum(len(e["spans"]) for e in self._active.values()),
+            }
+
     def clear(self) -> None:
         with self._lock:
             self._ring = []

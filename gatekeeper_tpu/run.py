@@ -30,6 +30,9 @@ with the selected operations; flags mirror the reference's surface:
   --fail-policy          open|closed — what a shed/expired/unevaluable
                          request gets (docs/robustness.md)
   --max-queue            admission queue bound (0 = unbounded)
+  --drain-grace          seconds /readyz reports not-ready before the
+                         webhook listener closes on SIGTERM (graceful
+                         drain, docs/robustness.md)
   --kube-url/--kube-token/--kube-ca  out-of-cluster apiserver access
 """
 
@@ -78,6 +81,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fail-policy", default="open",
                    choices=["open", "closed"])
     p.add_argument("--max-queue", type=int, default=2048)
+    # graceful drain: seconds /readyz reports not-ready while the
+    # webhook listener still accepts (SIGTERM flips readiness first,
+    # the LB routes away, THEN the listener closes and in-flight
+    # requests complete — docs/robustness.md)
+    p.add_argument("--drain-grace", type=float, default=1.0)
     # agent-action admission (docs/targets.md): registers the
     # AgentActionTarget so agent templates ingest and the webhook
     # serves POST /v1/agent/review
@@ -146,6 +154,7 @@ def build_runner(args, log=None, webhook_tls: bool = True):
         max_queue=(
             getattr(args, "max_queue", 2048) or None
         ),  # 0 -> unbounded
+        drain_grace_s=getattr(args, "drain_grace", 0.0),
         bind_addr="0.0.0.0",  # kubelet probes and the apiserver dial
         # the pod IP, not loopback
     )

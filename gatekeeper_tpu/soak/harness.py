@@ -1,0 +1,939 @@
+"""SoakHarness: the system under test + the scenario executor.
+
+Builds 1..N REAL `WebhookServer` replicas over HTTP(S) — validation,
+mutation, and agent-review planes live on every replica — with a
+self-contained policy corpus (no reference-library dependency: a soak
+must run on any machine), an in-process stub external-data provider,
+and, for multi-replica runs, the PR-7 fleet plane over one FakeCluster
+(shared Secret-backed certs, cache gossip, breaker gossip).
+
+The run is three concurrent machines:
+
+  * the open-loop generator (loadgen.py) posting Poisson arrivals
+    round-robin over the ACTIVE replicas — the load-balancer model:
+    a replica leaves rotation the instant its readiness flips
+    (`WebhookServer.on_drain`), which is exactly what a real LB
+    watching /readyz does;
+  * the scenario timer executing timeline events (constraint churn,
+    provider/mutator adds, fault arm/disarm against the PR-4 registry,
+    cert rotation through the fleet store, graceful replica kill);
+  * the window sampler recording server-side counters + the leak
+    series (RSS, cache entries + evictions, trace-ring size, metrics
+    series count, render-cache size) once per reporting window.
+
+The reporter (report.py) joins all three streams into the evidence
+artifact; `run_soak(scenario)` is the one-call entry.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ..faults import FAULTS, CircuitBreaker
+from .loadgen import CLIENT_TIMEOUT, CONN_ERROR, run_open_loop
+from .report import build_report
+from .scenario import Scenario
+
+K8S_TARGET = "admission.k8s.gatekeeper.sh"
+SOAK_PROVIDER = "soak-registry"
+
+_PRIV_REGO = """package soakprivileged
+
+violation[{"msg": msg}] {
+    input.review.object.spec.containers[_].securityContext.privileged
+    msg := "privileged container"
+}
+"""
+
+_EXT_REGO = """package soakexternal
+
+violation[{"msg": msg}] {
+    images := [img | img := input.review.object.spec.containers[_].image]
+    response := external_data({"provider": "soak-registry", "keys": images})
+    count(response.errors) > 0
+    msg := sprintf("image verification failed: %v", [response.errors])
+}
+"""
+
+_AGENT_REGO = """package soakagentshell
+
+allowed_cmd(c) { c == input.parameters.allowed[_] }
+violation[{"msg": msg}] {
+    cmd := input.review.object.spec.arguments.command
+    not allowed_cmd(cmd)
+    msg := sprintf("shell command <%v> is outside the allowlist", [cmd])
+}
+"""
+
+# churn templates get a distinct package + kind per add
+_CHURN_REGO = """package soakchurn{n}
+
+violation[{{"msg": msg}}] {{
+    input.review.object.metadata.labels["soak-churn-{n}"] == "deny"
+    msg := "churn label denied"
+}}
+"""
+
+
+def _template(kind: str, target: str, rego: str) -> Dict[str, Any]:
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": target, "rego": rego}],
+        },
+    }
+
+
+def _constraint(kind: str, name: str, match=None, params=None):
+    spec: Dict[str, Any] = {}
+    if match is not None:
+        spec["match"] = match
+    if params is not None:
+        spec["parameters"] = params
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+_POD_MATCH = {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}
+
+
+def _pod_request(i: int, violating: bool, external_keys: int = 12):
+    """A synthetic UPDATE AdmissionRequest whose image cycles the
+    external-data key universe (steady state = pure cache hits)."""
+    image = f"reg.example/app{i % external_keys}"
+    return {
+        "uid": f"soak-{i}",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": "UPDATE",
+        "name": f"pod{i}",
+        "namespace": f"ns{i % 7}",
+        "userInfo": {"username": "soak"},
+        "object": {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"pod{i}", "namespace": f"ns{i % 7}",
+                "labels": {"app": f"svc{i % 5}"},
+            },
+            "spec": {
+                "containers": [{
+                    "name": "main",
+                    "image": image,
+                    "securityContext": (
+                        {"privileged": True} if violating else {}
+                    ),
+                }],
+            },
+        },
+    }
+
+
+def _assign_metadata(name: str, label: str) -> Dict[str, Any]:
+    return {
+        "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+        "kind": "AssignMetadata",
+        "metadata": {"name": name},
+        "spec": {
+            "match": {"scope": "Namespaced"},
+            "location": f"metadata.labels.{label}",
+            "parameters": {"assign": {"value": "soak"}},
+        },
+    }
+
+
+class _StubProvider:
+    """In-process provider HTTP endpoint: answers the ProviderRequest
+    protocol, counts outbound fetches (the bounded-refetch evidence),
+    flags keys containing \"bad\"."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.fetches = 0
+        self.keys_fetched = 0
+        outer = self
+
+        class _H(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                keys = ((body.get("request") or {}).get("keys")) or []
+                outer.fetches += 1
+                outer.keys_fetched += len(keys)
+                payload = json.dumps({
+                    "response": {
+                        "items": [
+                            {"key": k, "error": "unsigned"}
+                            if "bad" in k
+                            else {"key": k, "value": f"ok:{k}"}
+                            for k in keys
+                        ],
+                        "systemError": "",
+                    }
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}/v"
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class _Replica:
+    """One webhook pod: client + driver + mutation/agent/external
+    systems + the serving WebhookServer, plus its fleet attachments."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.active = True  # in LB rotation
+        self.metrics = None
+        self.tracer = None
+        self.client = None
+        self.driver = None
+        self.external = None
+        self.mutation_system = None
+        self.server = None
+        self.fleet_plane = None
+        self.rotator = None
+
+    @property
+    def base_url(self) -> str:
+        return f"{self.server.scheme}://127.0.0.1:{self.server.port}"
+
+
+class SoakHarness:
+    def __init__(self, scenario: Scenario, err=None):
+        import sys
+
+        scenario.validate()
+        self.scenario = scenario
+        self.err = err if err is not None else sys.stderr
+        self.replicas: List[_Replica] = []
+        self.stub = _StubProvider()
+        self.cluster = None  # FakeCluster when fleet/tls is in play
+        self.transitions: List[Dict[str, Any]] = []
+        self.faults_log: List[Dict[str, Any]] = []
+        self.events_log: List[Dict[str, Any]] = []
+        self._window_samples: List[Dict[str, Any]] = []
+        self._churn_n = itertools.count(1)
+        self._req_n = itertools.count()
+        self._rr = itertools.count()  # LB round-robin cursor
+        self._t0 = time.monotonic()  # re-stamped at load start
+        self._stop = threading.Event()
+        self._saved_min_batch = None
+        # client-side TLS: availability is what the soak measures; the
+        # chain-validation contract is pinned by tests/test_fleet.py,
+        # so the LB model skips verification and keeps serving across
+        # CA rotations exactly like an apiserver with a caBundle lag
+        self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        self._ssl_ctx.check_hostname = False
+        self._ssl_ctx.verify_mode = ssl.CERT_NONE
+
+    def _log(self, msg: str) -> None:
+        print(f"soak: {msg}", file=self.err, flush=True)
+
+    # -- build ----------------------------------------------------------------
+
+    def build(self) -> None:
+        scn = self.scenario
+        if scn.min_device_batch is not None:
+            # the run-scoped form of GATEKEEPER_TPU_MIN_DEVICE_BATCH:
+            # at soak arrival rates micro-batches are small, and the
+            # adaptive floor would keep every batch on the interpreter
+            # — lowering it is what puts the REAL fused path under soak
+            from ..constraint import tpudriver as _td
+
+            self._saved_min_batch = _td.MIN_DEVICE_BATCH
+            _td.MIN_DEVICE_BATCH = int(scn.min_device_batch)
+        if scn.replicas > 1 or scn.tls:
+            from ..control.events import FakeCluster
+
+            self.cluster = FakeCluster()
+        for i in range(scn.replicas):
+            self.replicas.append(self._build_replica(f"soak-{i}"))
+        self._log(
+            f"built {len(self.replicas)} replica(s), "
+            f"tls={scn.tls}, constraints={scn.constraints}"
+        )
+
+    def _build_replica(self, name: str) -> _Replica:
+        from ..agentaction import AgentActionTarget
+        from ..constraint import Backend, K8sValidationTarget, TpuDriver
+        from ..externaldata import ExternalDataSystem
+        from ..metrics import MetricsRegistry
+        from ..mutation import MutationSystem
+        from ..obs import Tracer
+        from ..webhook.server import WebhookServer
+
+        scn = self.scenario
+        rep = _Replica(name)
+        rep.metrics = MetricsRegistry()
+        # small ring: warmup saturates it BEFORE the measured windows,
+        # so the leak sampler sees a full (flat) ring, not a filling one
+        rep.tracer = Tracer(max_traces=128)
+        rep.driver = TpuDriver()
+        rep.driver.set_metrics(rep.metrics)  # phase split + telemetry
+        rep.client = Backend(rep.driver).new_client(
+            K8sValidationTarget(), AgentActionTarget()
+        )
+        rep.external = ExternalDataSystem(metrics=rep.metrics)
+        if self.cluster is not None:
+            from ..fleet import FleetPlane
+
+            rep.fleet_plane = FleetPlane(
+                self.cluster, name,
+                metrics=rep.metrics, publish_interval_s=0.1,
+            )
+            rep.fleet_plane.attach_cache(rep.external)
+        rep.external.upsert({
+            "apiVersion": "externaldata.gatekeeper.sh/v1alpha1",
+            "kind": "Provider",
+            "metadata": {"name": SOAK_PROVIDER},
+            "spec": {
+                "url": self.stub.url,
+                "timeout": 5,
+                "failurePolicy": "Ignore",
+                "cacheTTLSeconds": 3600,
+                "negativeCacheTTLSeconds": 3600,
+            },
+        })
+        rep.client.set_external_data(rep.external)
+        rep.client.add_template(
+            _template("SoakPrivileged", K8S_TARGET, _PRIV_REGO)
+        )
+        rep.client.add_template(
+            _template("SoakExternal", K8S_TARGET, _EXT_REGO)
+        )
+        for i in range(scn.constraints):
+            rep.client.add_constraint(
+                _constraint("SoakPrivileged", f"w{i}", match=_POD_MATCH)
+            )
+        rep.client.add_constraint(
+            _constraint("SoakExternal", "ext", match=_POD_MATCH)
+        )
+        from ..agentaction import TARGET_NAME as AGENT_TARGET
+
+        rep.client.add_template(
+            _template("SoakAgentShell", AGENT_TARGET, _AGENT_REGO)
+        )
+        rep.client.add_constraint(
+            _constraint(
+                "SoakAgentShell", "shell",
+                match={"tools": ["shell.*"]},
+                params={"allowed": ["ls", "cat"]},
+            )
+        )
+        rep.mutation_system = MutationSystem(metrics=rep.metrics)
+        rep.mutation_system.upsert(_assign_metadata("soak-base", "soak"))
+
+        rotator = None
+        if scn.tls:
+            import tempfile
+
+            from ..fleet import FleetCertRotator, SecretCertStore
+
+            store = SecretCertStore(
+                self.cluster, name="soak-webhook-cert",
+                namespace="gatekeeper-system", replica_id=name,
+                metrics=rep.metrics,
+            )
+            rotator = FleetCertRotator(
+                tempfile.mkdtemp(prefix=f"gk-soak-{name}-"), store,
+                metrics=rep.metrics,
+            )
+            rotator.ensure()
+            rotator.start()
+        rep.rotator = rotator
+
+        rep.server = WebhookServer(
+            rep.client,
+            K8S_TARGET,
+            agent_review=True,
+            mutation_system=rep.mutation_system,
+            metrics=rep.metrics,
+            tracer=rep.tracer,
+            tls=scn.tls,
+            rotator=rotator,
+            window_ms=scn.window_ms,
+            request_timeout=max(5.0, scn.deadline_s * 8),
+        )
+        # scenario-tuned breakers (the stock 30 s recovery would spend
+        # a whole fault window waiting): share metrics/tracer so the
+        # transition series and spans land in the same registries
+        br = scn.breaker
+        for batcher, plane in (
+            (rep.server.batcher, "validation"),
+            (rep.server.mutate_batcher, "mutation"),
+            (rep.server.agent_batcher, "agent"),
+        ):
+            if batcher is None:
+                continue
+            breaker = CircuitBreaker(
+                failure_threshold=int(br.get("failure_threshold", 3)),
+                recovery_seconds=float(br.get("recovery_seconds", 5.0)),
+                plane=plane,
+                metrics=rep.metrics,
+                tracer=rep.tracer,
+            )
+            batcher.breaker = breaker
+            breaker.subscribe(
+                lambda f, t, plane=plane, replica=name: (
+                    self.transitions.append({
+                        "t_s": round(time.monotonic() - self._t0, 3),
+                        "replica": replica,
+                        "plane": plane,
+                        "from": f,
+                        "to": t,
+                    })
+                )
+            )
+            if rep.fleet_plane is not None:
+                rep.fleet_plane.register_breaker(
+                    f"device:{plane}", breaker
+                )
+        if rep.fleet_plane is not None:
+            rep.fleet_plane.start()
+        # the LB model: readiness flip takes the replica out of
+        # rotation BEFORE the listener closes (graceful drain)
+        rep.server.on_drain(
+            lambda rep=rep: setattr(rep, "active", False)
+        )
+        rep.server.start()
+        return rep
+
+    # -- request bodies -------------------------------------------------------
+
+    def _pod_request(self, i: int, violating: bool) -> Dict[str, Any]:
+        return _pod_request(i, violating, self.scenario.external_keys)
+
+    def _body(self, plane: str) -> bytes:
+        i = next(self._req_n)
+        scn = self.scenario
+        violating = (i % 997) / 997.0 < scn.violating_fraction
+        if plane == "agent":
+            doc = {
+                "apiVersion": "agentaction.gatekeeper.sh/v1",
+                "kind": "AgentActionReview",
+                "request": {
+                    "uid": f"call-{i}",
+                    "id": f"call-{i}",
+                    "agent": f"planner-{i % 3}",
+                    "session": f"s-{i % 11}",
+                    "tool": "shell.exec",
+                    "arguments": {
+                        "command": "rm" if violating else "ls"
+                    },
+                    "capabilities": ["exec"],
+                    "skill": {"name": "fs-tools", "publisher": "acme",
+                              "signed": True, "digest": "sha256:abc"},
+                },
+            }
+        else:
+            doc = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": self._pod_request(i, violating),
+            }
+        return json.dumps(doc).encode()
+
+    _PATHS = {
+        "validation": "/v1/admit",
+        "mutation": "/v1/mutate",
+        "agent": "/v1/agent/review",
+    }
+
+    def _submit(self, plane: str):
+        """One open-loop request: round-robin over ACTIVE replicas,
+        POST, classify. Returns (status, outcome) for the generator."""
+        live = [r for r in self.replicas if r.active]
+        if not live:
+            return 0, CONN_ERROR
+        rep = live[next(self._rr) % len(live)]
+        body = self._body(plane)
+        url = rep.base_url + self._PATHS[plane]
+        timeout = max(5.0, self.scenario.deadline_s * 8)
+        req = urllib.request.Request(
+            url, data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout,
+                context=self._ssl_ctx if self.scenario.tls else None,
+            ) as resp:
+                doc = json.loads(resp.read())
+            allowed = bool(
+                ((doc.get("response") or {}).get("allowed", False))
+            )
+            return 200, ("ok" if allowed else "denied")
+        except urllib.error.HTTPError as e:
+            return int(e.code), f"http_{e.code}"
+        except TimeoutError:
+            return 0, CLIENT_TIMEOUT
+        except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None), TimeoutError):
+                return 0, CLIENT_TIMEOUT
+            return 0, CONN_ERROR
+        except (ConnectionError, OSError):
+            return 0, CONN_ERROR
+
+    # -- scenario actions -----------------------------------------------------
+
+    def _run_event(self, action: str, params: Dict[str, Any]) -> None:
+        if action == "phase":
+            return  # phases only label reporting windows
+        if action == "add_constraints":
+            count = int(params.get("count", 25))
+            stamp = next(self._churn_n)  # unique names across adds
+            for rep in self.replicas:
+                for j in range(count):
+                    rep.client.add_constraint(_constraint(
+                        "SoakPrivileged", f"churn{stamp}-{j}",
+                        match=_POD_MATCH,
+                    ))
+        elif action == "add_template":
+            n = next(self._churn_n)
+            kind = f"SoakChurn{n}"
+            rego = _CHURN_REGO.format(n=n)
+            for rep in self.replicas:
+                rep.client.add_template(_template(kind, K8S_TARGET, rego))
+                rep.client.add_constraint(
+                    _constraint(kind, f"churn-t{n}", match=_POD_MATCH)
+                )
+        elif action == "add_provider":
+            n = next(self._churn_n)
+            for rep in self.replicas:
+                rep.external.upsert({
+                    "apiVersion": "externaldata.gatekeeper.sh/v1alpha1",
+                    "kind": "Provider",
+                    "metadata": {"name": f"soak-extra-{n}"},
+                    "spec": {
+                        "url": self.stub.url,
+                        "timeout": 5,
+                        "failurePolicy": "Ignore",
+                        "cacheTTLSeconds": 600,
+                    },
+                })
+        elif action == "add_mutator":
+            n = next(self._churn_n)
+            for rep in self.replicas:
+                rep.mutation_system.upsert(
+                    _assign_metadata(f"soak-churn-{n}", f"soak-{n}")
+                )
+        elif action == "arm_fault":
+            FAULTS.arm(
+                params["point"],
+                mode=params.get("mode", "error"),
+                count=int(params.get("count", -1)),
+                after=int(params.get("after", 0)),
+                delay_s=float(params.get("delay", 0.05)),
+            )
+        elif action == "disarm_faults":
+            snap = FAULTS.snapshot()
+            self.faults_log.append({
+                "t_s": round(time.monotonic() - self._t0, 3),
+                "disarmed": snap,
+            })
+            FAULTS.reset()
+        elif action == "rotate_certs":
+            rep = next(
+                (r for r in self.replicas if r.active and r.rotator),
+                None,
+            )
+            if rep is None:
+                self._log("rotate_certs: no TLS rotator (no-op)")
+                return
+            rot = rep.rotator
+            rec, _won = rot.store.offer(
+                rot.generate_pair(),
+                expected_generation=rot.cert_generation,
+            )
+            rot._install_record(rec)
+            self._log(
+                f"rotated certs via {rep.name} -> generation "
+                f"{rot.cert_generation}"
+            )
+        elif action == "kill_replica":
+            idx = int(params.get("replica", 0))
+            rep = self.replicas[idx]
+            rep.active = False  # LB-out first (readiness model)
+
+            def _graceful():
+                # graceful drain: readiness already flipped; the server
+                # closes its listener and completes in-flight requests
+                rep.server.stop()
+                if rep.fleet_plane is not None:
+                    rep.fleet_plane.stop()
+                if rep.rotator is not None:
+                    rep.rotator.stop()
+
+            threading.Thread(
+                target=_graceful, name=f"gk-soak-kill-{rep.name}",
+                daemon=True,
+            ).start()
+        else:  # pragma: no cover - Scenario.validate rejects these
+            raise ValueError(f"unknown action {action!r}")
+
+    def _event_loop(self) -> None:
+        for ev in self.scenario.events:
+            while not self._stop.is_set():
+                delay = (self._t0 + ev.at_s) - time.monotonic()
+                if delay <= 0:
+                    break
+                self._stop.wait(min(delay, 0.2))
+            if self._stop.is_set():
+                return
+            t_rel = round(time.monotonic() - self._t0, 3)
+            try:
+                self._run_event(ev.action, ev.params)
+                self._log(f"event t={t_rel}s: {ev.action} {ev.params}")
+                self.events_log.append({
+                    "t_s": t_rel, "action": ev.action, **ev.params,
+                })
+            except Exception as e:
+                self._log(f"event t={t_rel}s {ev.action} FAILED: {e}")
+                self.events_log.append({
+                    "t_s": t_rel, "action": ev.action,
+                    "error": str(e), **ev.params,
+                })
+
+    # -- per-window sampling --------------------------------------------------
+
+    def _rss_kb(self) -> Optional[int]:
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1])
+        except OSError:
+            return None
+        return None
+
+    def _cumulative(self) -> Dict[str, Any]:
+        """Cumulative server-side counters + instantaneous gauges,
+        summed over replicas (dead replicas keep their last counts —
+        diffs stay correct)."""
+        shed = failures = cache_entries = cache_evictions = 0
+        trace_ring = metrics_series = render_cache = 0
+        cert_gen = 0
+        for rep in self.replicas:
+            for b in (
+                rep.server.batcher,
+                rep.server.mutate_batcher,
+                rep.server.agent_batcher,
+                rep.server.agent_mutate_batcher,
+            ):
+                if b is not None:
+                    shed += b.shed_count
+                    failures += b.batch_failures
+            cache_entries += len(rep.external.cache)
+            cache_evictions += rep.external.cache.evictions
+            trace_ring += rep.tracer.size()["ring"]
+            metrics_series += rep.metrics.series_count()
+            size_fn = getattr(rep.driver, "render_cache_size", None)
+            if size_fn is not None:
+                render_cache += size_fn()
+            if rep.rotator is not None:
+                cert_gen = max(cert_gen, rep.rotator.cert_generation)
+        return {
+            "shed_cum": shed,
+            "batch_failures_cum": failures,
+            "transitions_cum": len(self.transitions),
+            "fetches_cum": self.stub.fetches,
+            "cache_entries": cache_entries,
+            "cache_evictions": cache_evictions,
+            "trace_ring": trace_ring,
+            "metrics_series": metrics_series,
+            "render_cache": render_cache,
+            "rss_kb": self._rss_kb(),
+            "cert_generation": cert_gen,
+        }
+
+    def _sampler_loop(self) -> None:
+        scn = self.scenario
+        n_windows = max(1, int(round(scn.duration_s / scn.window_s)))
+        prev = self._cumulative()
+        for i in range(n_windows):
+            target = self._t0 + (i + 1) * scn.window_s
+            while not self._stop.is_set():
+                delay = target - time.monotonic()
+                if delay <= 0:
+                    break
+                self._stop.wait(min(delay, 0.2))
+            cur = self._cumulative()
+            self._window_samples.append({
+                "shed": cur["shed_cum"] - prev["shed_cum"],
+                "batch_failures": (
+                    cur["batch_failures_cum"]
+                    - prev["batch_failures_cum"]
+                ),
+                "breaker_transitions": (
+                    cur["transitions_cum"] - prev["transitions_cum"]
+                ),
+                "fetches": cur["fetches_cum"] - prev["fetches_cum"],
+                "cache_entries": cur["cache_entries"],
+                "cache_evictions": cur["cache_evictions"],
+                "trace_ring": cur["trace_ring"],
+                "metrics_series": cur["metrics_series"],
+                "render_cache": cur["render_cache"],
+                "rss_kb": cur["rss_kb"],
+                "cert_generation": cur["cert_generation"],
+            })
+            prev = cur
+            if self._stop.is_set():
+                return
+
+    # -- device-time split ----------------------------------------------------
+
+    def _device_time_split(self) -> Dict[str, Any]:
+        """Aggregate the driver's phase_seconds metric across replicas:
+        where a second of admission work actually went — host
+        flatten/encode vs device execution vs violation render. This is
+        the utilization denominator ROADMAP item 1/3 speed work is
+        judged against."""
+        import re
+
+        totals: Dict[str, float] = {}
+        rx = re.compile(r'phase="([a-z_]+)"')
+        for rep in self.replicas:
+            dists = rep.metrics.snapshot()["distributions"]
+            for key, d in dists.items():
+                if not key.startswith("driver_phase_seconds"):
+                    continue
+                m = rx.search(key)
+                if not m:
+                    continue
+                totals[m.group(1)] = (
+                    totals.get(m.group(1), 0.0) + float(d["sum"])
+                )
+        total = sum(totals.values())
+        out: Dict[str, Any] = {
+            "seconds": {k: round(v, 4) for k, v in sorted(totals.items())}
+        }
+        if total > 0:
+            out["fractions"] = {
+                k: round(v / total, 4) for k, v in sorted(totals.items())
+            }
+            # the utilization headline: device share of total work
+            out["device_fraction"] = round(
+                totals.get("device_dispatch", 0.0) / total, 4
+            )
+        return out
+
+    # -- warmup / run / teardown ----------------------------------------------
+
+    def warmup(self) -> float:
+        """Closed-loop pre-load: compile the fused routes and fill the
+        external-data cache so the measured windows start from steady
+        state (cold compile belongs to readiness, not to the SLO)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        t0 = time.monotonic()
+        for rep in self.replicas:
+            try:
+                rep.server.warmup()
+            except Exception:
+                pass
+        with ThreadPoolExecutor(max_workers=16) as ex:
+            for plane, n in (
+                ("validation", 96), ("mutation", 32), ("agent", 32)
+            ):
+                list(ex.map(lambda _i: self._submit(plane), range(n)))
+        # serial pass: open-loop arrivals make batch sizes 1-2, whose
+        # pad buckets differ from the concurrent burst's — compile them
+        # here, not inside the first measured window
+        for plane in ("validation", "agent", "mutation"):
+            for _ in range(4 * max(1, len(self.replicas))):
+                self._submit(plane)
+        return time.monotonic() - t0
+
+    def run(self) -> Dict[str, Any]:
+        scn = self.scenario
+        self.build()
+        warm_s = self.warmup()
+        self._log(f"warmup {warm_s:.1f}s; starting open loop "
+                  f"@{scn.rps}rps for {scn.duration_s}s")
+        self._t0 = time.monotonic()
+        threads = [
+            threading.Thread(
+                target=self._event_loop, name="gk-soak-events",
+                daemon=True,
+            ),
+            threading.Thread(
+                target=self._sampler_loop, name="gk-soak-sampler",
+                daemon=True,
+            ),
+        ]
+        for th in threads:
+            th.start()
+        try:
+            load = run_open_loop(
+                self._submit,
+                rps=scn.rps,
+                duration_s=scn.duration_s,
+                deadline_s=scn.deadline_s,
+                planes=scn.planes,
+                seed=scn.seed,
+            )
+        finally:
+            self._stop.set()
+            for th in threads:
+                th.join(timeout=5)
+            FAULTS.reset()
+        split = self._device_time_split()
+        capacity = None
+        if scn.capacity:
+            capacity = run_capacity_model(
+                scn.capacity, scn.deadline_s, err=self.err
+            )
+        report = build_report(
+            scn.to_dict(),
+            load,
+            self._window_samples,
+            self.transitions,
+            split,
+            capacity=capacity,
+            faults_log=self.faults_log,
+            extra={
+                "events_log": self.events_log,
+                "warmup_seconds": round(warm_s, 1),
+                "provider_fetches_total": self.stub.fetches,
+            },
+        )
+        return report
+
+    def stop(self) -> None:
+        self._stop.set()
+        FAULTS.reset()
+        if self._saved_min_batch is not None:
+            from ..constraint import tpudriver as _td
+
+            _td.MIN_DEVICE_BATCH = self._saved_min_batch
+            self._saved_min_batch = None
+        for rep in self.replicas:
+            try:
+                if rep.server is not None:
+                    rep.server.stop()
+            except Exception:
+                pass
+            if rep.fleet_plane is not None:
+                rep.fleet_plane.stop()
+            if rep.rotator is not None:
+                rep.rotator.stop()
+        self.stub.stop()
+
+
+def run_capacity_model(
+    cfg: Dict[str, Any], deadline_s: float, err=None
+) -> List[Dict[str, Any]]:
+    """Max sustainable rps at the p99 SLO vs constraint count: for each
+    count, step the open-loop rate up the configured levels until a
+    probe window's attainment drops below 99% — the last passing level
+    is the capacity. Handler-level (no HTTP client noise): this models
+    ENGINE capacity; the sustained-run numbers include transport."""
+    import sys
+
+    from ..constraint import Backend, K8sValidationTarget, TpuDriver
+    from ..webhook.server import BatchedValidationHandler, MicroBatcher
+
+    err = err if err is not None else sys.stderr
+    counts = list(cfg.get("constraint_counts", [10, 100]))
+    levels = list(cfg.get("rps_levels", [25, 50, 100, 200]))
+    probe_s = float(cfg.get("probe_s", 3.0))
+    out: List[Dict[str, Any]] = []
+    for n_con in counts:
+        client = Backend(TpuDriver()).new_client(K8sValidationTarget())
+        client.add_template(
+            _template("SoakPrivileged", K8S_TARGET, _PRIV_REGO)
+        )
+        for i in range(n_con):
+            client.add_constraint(
+                _constraint("SoakPrivileged", f"c{i}", match=_POD_MATCH)
+            )
+        batcher = MicroBatcher(client, K8S_TARGET, window_ms=2.0)
+        handler = BatchedValidationHandler(batcher, request_timeout=30)
+        batcher.start()
+        counter = itertools.count()
+
+        def submit(_plane: str):
+            i = next(counter)
+            resp = handler.handle(_pod_request(i, violating=(i % 10 == 0)))
+            return 200, ("ok" if resp.allowed else "denied")
+
+        row: Dict[str, Any] = {"constraints": n_con, "levels": []}
+        max_ok = None
+        try:
+            # warm the route + jit buckets outside the measurement
+            from ..constraint import AugmentedReview
+
+            client.warm_review_path([
+                AugmentedReview(_pod_request(i, False))
+                for i in range(16)
+            ])
+            run_open_loop(
+                submit, rps=min(levels), duration_s=1.0,
+                deadline_s=deadline_s,
+            )
+            for rps in levels:
+                load = run_open_loop(
+                    submit, rps=rps, duration_s=probe_s,
+                    deadline_s=deadline_s,
+                )
+                lats = sorted(s.latency_s for s in load.samples)
+                p99 = lats[int(0.99 * (len(lats) - 1))] if lats else 0.0
+                att = load.slo_attainment()
+                row["levels"].append({
+                    "rps": rps,
+                    "achieved_rps": load.achieved_rps,
+                    "p99_ms": round(p99 * 1e3, 2),
+                    "attainment": round(att, 4),
+                })
+                print(
+                    f"soak capacity: c={n_con} rps={rps} "
+                    f"p99={p99 * 1e3:.1f}ms att={att:.3f}",
+                    file=err, flush=True,
+                )
+                if att >= 0.99 and p99 <= deadline_s:
+                    max_ok = rps
+                else:
+                    break
+        finally:
+            batcher.stop()
+        row["max_rps_at_slo"] = max_ok
+        out.append(row)
+    return out
+
+
+def run_soak(scenario: Scenario, err=None) -> Dict[str, Any]:
+    """Build, run, and tear down one soak scenario; returns the report
+    (report.py's schema; `summarize_soak` renders the SUMMARY line)."""
+    harness = SoakHarness(scenario, err=err)
+    try:
+        return harness.run()
+    finally:
+        harness.stop()

@@ -1,0 +1,388 @@
+"""Soak reporting: windows, phases, leak evidence, acceptance checks.
+
+The reporter turns two streams into one JSON artifact:
+
+  * the generator's per-request samples (scheduled time, latency,
+    status) binned into fixed windows with per-window p50/p99 and SLO
+    attainment (fraction answered 200 within the deadline — misses,
+    client timeouts, connection errors and unserved arrivals all count
+    against it);
+  * the harness sampler's per-window server-side observations (shed
+    counts, breaker transitions, outbound fetches, cert generation,
+    and the leak series: RSS, cache entries, trace-ring size, metrics
+    series count, render-cache size).
+
+Phases (scenario `phase` events) aggregate windows; the acceptance
+checks read the conventional phase names — `fault` must degrade and
+`recovery` must restore the SLO with breaker transitions logged,
+`churn` must stay 5xx-free, `kill` must keep shed bounded — and the
+leak checker flags any sampled series that grows monotonically across
+the steady windows.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+from .loadgen import OpenLoopLoad, Sample
+
+# top-level fields every soak JSON must carry (the CI schema gate)
+SOAK_SCHEMA_FIELDS = (
+    "scenario", "windows", "phases", "slo", "shed",
+    "breaker_transitions", "leak", "device_time_split", "checks",
+)
+
+# fraction of kill-phase requests allowed to fail before "bounded shed"
+# flips false (a graceful drain should shed ~zero; 2% leaves room for
+# the LB-flip race on a loaded box)
+KILL_SHED_BOUND = 0.02
+
+
+def _pct(sorted_vals: Sequence[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def bin_windows(
+    samples: List[Sample],
+    duration_s: float,
+    window_s: float,
+    deadline_s: float,
+    phase_at: Optional[Dict[float, str]] = None,
+) -> List[Dict[str, Any]]:
+    """Samples -> per-window rows. `phase_at` maps event times to phase
+    labels; a window takes the label active at its start."""
+    n_windows = max(1, int(round(duration_s / window_s)))
+    rows: List[Dict[str, Any]] = []
+    marks = sorted((phase_at or {}).items())
+
+    def phase_for(t: float) -> str:
+        label = ""
+        for at, name in marks:
+            if at <= t + 1e-9:
+                label = name
+        return label
+
+    buckets: List[List[Sample]] = [[] for _ in range(n_windows)]
+    for s in samples:
+        idx = int(s.t_rel / window_s)
+        if 0 <= idx < n_windows:
+            buckets[idx].append(s)
+        elif idx >= n_windows:
+            buckets[-1].append(s)
+    for i, bucket in enumerate(buckets):
+        lats = sorted(s.latency_s for s in bucket)
+        ok = sum(1 for s in bucket if s.ok_within(deadline_s))
+        err5xx = sum(1 for s in bucket if s.status >= 500)
+        conn = sum(
+            1 for s in bucket
+            if s.outcome in ("conn_error", "client_timeout", "unserved")
+        )
+        rows.append({
+            "t0_s": round(i * window_s, 3),
+            "t1_s": round((i + 1) * window_s, 3),
+            "phase": phase_for(i * window_s),
+            "requests": len(bucket),
+            "rps": round(len(bucket) / window_s, 2),
+            "p50_ms": round(_pct(lats, 0.50) * 1e3, 2),
+            "p99_ms": round(_pct(lats, 0.99) * 1e3, 2),
+            "slo_attainment": round(ok / len(bucket), 4) if bucket else None,
+            "slo_misses": len(bucket) - ok,
+            "http_5xx": err5xx,
+            "transport_errors": conn,
+        })
+    return rows
+
+
+def aggregate_phases(windows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    order: List[str] = []
+    by_phase: Dict[str, List[Dict[str, Any]]] = {}
+    for w in windows:
+        p = w.get("phase") or ""
+        if p not in by_phase:
+            by_phase[p] = []
+            order.append(p)
+        by_phase[p].append(w)
+    out = []
+    for p in order:
+        ws = by_phase[p]
+        total = sum(w["requests"] for w in ws)
+        ok = sum(
+            w["requests"] - w["slo_misses"] for w in ws
+        )
+        out.append({
+            "phase": p,
+            "windows": len(ws),
+            "requests": total,
+            "slo_attainment": round(ok / total, 4) if total else None,
+            "worst_p99_ms": max((w["p99_ms"] for w in ws), default=0.0),
+            "best_p99_ms": min(
+                (w["p99_ms"] for w in ws if w["requests"]), default=0.0
+            ),
+            "http_5xx": sum(w["http_5xx"] for w in ws),
+            "transport_errors": sum(w["transport_errors"] for w in ws),
+            "shed": sum(w.get("shed", 0) for w in ws),
+            "breaker_transitions": sum(
+                w.get("breaker_transitions", 0) for w in ws
+            ),
+            "fetches": sum(w.get("fetches", 0) for w in ws),
+        })
+    return out
+
+
+def monotonic_growth(
+    values: Sequence[float],
+    tol_frac: float = 0.10,
+    min_windows: int = 6,
+) -> bool:
+    """True when a sampled series looks like a leak: enough windows,
+    (almost) never decreasing, and net growth beyond tolerance. A
+    series that plateaus — cache fills to its bound, RSS settles after
+    warmup — must NOT flag, which is why the nondecreasing-step ratio
+    matters and not just first-vs-last."""
+    vals = [float(v) for v in values if v is not None]
+    if len(vals) < min_windows:
+        return False
+    first = vals[0]
+    last = vals[-1]
+    if last <= first * (1 + tol_frac) + 1e-9:
+        return False
+    steps = list(zip(vals, vals[1:]))
+    increases = sum(1 for a, b in steps if b > a + 1e-9)
+    decreases = sum(1 for a, b in steps if b < a - 1e-9)
+    # a leak grows in most windows and essentially never shrinks; the
+    # "essentially" absorbs one GC/eviction blip
+    return increases >= len(steps) * 0.5 and decreases <= 1
+
+
+def leak_report(
+    window_stats: List[Dict[str, Any]],
+    steady_phases: Sequence[str] = ("steady",),
+    tolerances: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """Per-series leak verdicts over the STEADY windows (churn/fault
+    windows legitimately grow caches; judging them would cry wolf).
+    Falls back to all windows when no steady phase was labeled."""
+    tol = {
+        "rss_kb": 0.25,  # allocator slack + lazy JAX buffers
+        "cache_entries": 0.10,
+        "trace_ring": 0.10,
+        "metrics_series": 0.10,
+        "render_cache": 0.10,
+    }
+    tol.update(tolerances or {})
+    steady = [
+        w for w in window_stats if (w.get("phase") or "") in steady_phases
+    ]
+    # a leak verdict needs enough STEADY evidence: churn/fault windows
+    # legitimately grow every cache, so judging them would cry wolf.
+    # With too few steady windows the curves are still reported, but
+    # nothing flags — insufficient evidence is not evidence of a leak.
+    sufficient = len(steady) >= 4
+    judged = steady if sufficient else window_stats
+    series: Dict[str, Any] = {}
+    flagged = []
+    for name, t in tol.items():
+        vals = [w.get(name) for w in judged if w.get(name) is not None]
+        growing = sufficient and monotonic_growth(vals, tol_frac=t)
+        series[name] = {
+            "samples": vals,
+            "tolerance_frac": t,
+            "monotonic_growth": growing,
+        }
+        if growing:
+            flagged.append(name)
+    return {
+        "steady_windows": len(steady),
+        "sufficient_steady_windows": sufficient,
+        "series": series,
+        "flagged": flagged,
+        "flat": not flagged,
+    }
+
+
+def build_checks(
+    phases: List[Dict[str, Any]],
+    leak: Dict[str, Any],
+    transitions: List[Dict[str, Any]],
+    windows: List[Dict[str, Any]],
+) -> Dict[str, Any]:
+    by_name = {p["phase"]: p for p in phases}
+    checks: Dict[str, Any] = {}
+    fault = by_name.get("fault")
+    recovery = by_name.get("recovery")
+    if fault and recovery:
+        degraded = (fault["slo_attainment"] or 0.0) < 0.9
+        recovered = (recovery["slo_attainment"] or 0.0) >= 0.95
+        trans_in_fault = fault.get("breaker_transitions", 0) > 0 or any(
+            t for t in transitions
+        )
+        checks["fault_window_degrades_and_recovers"] = bool(
+            degraded and recovered and trans_in_fault
+        )
+    churn = by_name.get("churn")
+    if churn:
+        checks["churn_zero_5xx"] = (
+            churn["http_5xx"] == 0 and churn["transport_errors"] == 0
+        )
+    kill = by_name.get("kill")
+    if kill and kill["requests"]:
+        failed = (
+            kill["http_5xx"] + kill["transport_errors"] + kill["shed"]
+        )
+        checks["replica_kill_shed_bounded"] = (
+            failed / kill["requests"] <= KILL_SHED_BOUND
+        )
+    checks["leak_flat"] = bool(leak.get("flat"))
+    steady_windows = [
+        w for w in windows if (w.get("phase") or "") == "steady"
+    ]
+    checks["steady_seconds"] = round(
+        sum(w["t1_s"] - w["t0_s"] for w in steady_windows), 1
+    )
+    return checks
+
+
+def build_report(
+    scenario_dict: Dict[str, Any],
+    load: OpenLoopLoad,
+    window_stats: List[Dict[str, Any]],
+    transitions: List[Dict[str, Any]],
+    device_time_split: Dict[str, float],
+    capacity: Optional[List[Dict[str, Any]]] = None,
+    faults_log: Optional[List[Dict[str, Any]]] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Merge generator samples + sampler rows into the soak artifact.
+    `window_stats` rows carry server-side per-window observations and
+    are matched to sample windows by index."""
+    phase_at = {
+        float(e["at"]): e.get("name", "")
+        for e in scenario_dict.get("events", [])
+        if e.get("action") == "phase"
+    }
+    windows = bin_windows(
+        load.samples,
+        scenario_dict["duration_s"],
+        scenario_dict["window_s"],
+        scenario_dict["deadline_s"],
+        phase_at=phase_at,
+    )
+    for i, w in enumerate(windows):
+        if i < len(window_stats):
+            w.update(window_stats[i])
+    phases = aggregate_phases(windows)
+    leak = leak_report(windows)
+    checks = build_checks(phases, leak, transitions, windows)
+    total = len(load.samples)
+    ok = sum(
+        1 for s in load.samples
+        if s.ok_within(scenario_dict["deadline_s"])
+    )
+    shed_total = sum(w.get("shed", 0) for w in windows)
+    report = {
+        "scenario": scenario_dict,
+        "open_loop": {
+            "target_rps": load.target_rps,
+            "achieved_rps": load.achieved_rps,
+            "generated": load.generated,
+            "observed": total,
+        },
+        "slo": {
+            "deadline_s": scenario_dict["deadline_s"],
+            "attainment": round(ok / total, 4) if total else None,
+            "misses": total - ok,
+            "worst_window_p99_ms": max(
+                (w["p99_ms"] for w in windows if w["requests"]),
+                default=0.0,
+            ),
+        },
+        "shed": {
+            "total": shed_total,
+            "rate": round(shed_total / total, 4) if total else 0.0,
+        },
+        "windows": windows,
+        "phases": phases,
+        "breaker_transitions": transitions,
+        "faults": faults_log or [],
+        "device_time_split": device_time_split,
+        "leak": leak,
+        "checks": checks,
+    }
+    if capacity is not None:
+        report["capacity_model"] = capacity
+    if extra:
+        report.update(extra)
+    return report
+
+
+def check_soak_schema(doc: Dict[str, Any]) -> List[str]:
+    """Missing-field list (empty = valid). The CI gate runs this over
+    both a live smoke run and the checked-in SOAK_r01.json so the
+    artifact format cannot silently drift from the reader."""
+    problems = []
+    for f in SOAK_SCHEMA_FIELDS:
+        if f not in doc:
+            problems.append(f"missing field: {f}")
+    slo = doc.get("slo") or {}
+    for f in ("deadline_s", "attainment", "misses", "worst_window_p99_ms"):
+        if f not in slo:
+            problems.append(f"missing slo.{f}")
+    shed = doc.get("shed") or {}
+    for f in ("total", "rate"):
+        if f not in shed:
+            problems.append(f"missing shed.{f}")
+    leak = doc.get("leak") or {}
+    for f in ("series", "flagged", "flat"):
+        if f not in leak:
+            problems.append(f"missing leak.{f}")
+    for w in doc.get("windows") or []:
+        for f in ("t0_s", "phase", "requests", "p99_ms", "slo_attainment"):
+            if f not in w:
+                problems.append(f"window missing {f}")
+                break
+        break  # shape-check the first row; rows are built by one loop
+    return problems
+
+
+def summarize_soak(res: Dict[str, Any]) -> str:
+    """The compact driver-parseable line (the bench SUMMARY contract):
+    headline SLO/shed/leak numbers that survive a truncated capture."""
+    head: Dict[str, Any] = {"mode": "soak"}
+    try:
+        scn = res.get("scenario") or {}
+        head["scenario"] = scn.get("name")
+        head["duration_s"] = scn.get("duration_s")
+        ol = res.get("open_loop") or {}
+        head["target_rps"] = ol.get("target_rps")
+        head["achieved_rps"] = ol.get("achieved_rps")
+        slo = res.get("slo") or {}
+        head["slo_attainment"] = slo.get("attainment")
+        head["worst_window_p99_ms"] = slo.get("worst_window_p99_ms")
+        head["shed_rate"] = (res.get("shed") or {}).get("rate")
+        head["breaker_transitions"] = len(
+            res.get("breaker_transitions") or []
+        )
+        head["leak_flagged"] = (res.get("leak") or {}).get("flagged")
+        head["checks"] = res.get("checks")
+    except Exception as e:  # the summary must never kill the artifact
+        head["error"] = str(e)
+    return "SUMMARY: " + json.dumps(head, default=str)
+
+
+def parse_summary_line(line: str) -> Dict[str, Any]:
+    """Round-trip reader for the SUMMARY line (the schema test's other
+    half). Raises on anything that is not a soak summary."""
+    prefix = "SUMMARY: "
+    if not line.startswith(prefix):
+        raise ValueError(f"not a SUMMARY line: {line[:40]!r}")
+    doc = json.loads(line[len(prefix):])
+    if doc.get("mode") != "soak":
+        raise ValueError(f"not a soak summary: mode={doc.get('mode')!r}")
+    for f in ("slo_attainment", "shed_rate", "leak_flagged"):
+        if f not in doc:
+            raise ValueError(f"soak summary missing {f!r}")
+    return doc

@@ -1,0 +1,300 @@
+"""Declarative soak scenarios: a timeline of events over sustained load.
+
+A scenario is a plain dict (checked by `Scenario.from_dict`) so runs
+are reproducible from a JSON file checked in next to their evidence
+artifact. The shape:
+
+    {
+      "name": "soak-default",
+      "duration_s": 150,          # open-loop load window
+      "rps": 60,                  # fixed Poisson arrival rate
+      "deadline_s": 0.25,         # the SLO: answered within deadline
+      "window_s": 5,              # reporting window size
+      "seed": 1234,               # arrival/plane RNG seed
+      "replicas": 2,              # real WebhookServer replicas
+      "tls": true,                # HTTPS + fleet Secret cert store
+      "constraints": 30,          # initial constraint count
+      "external_keys": 12,        # external-data key universe
+      "planes": {"validation": 0.7, "mutation": 0.15, "agent": 0.15},
+      "breaker": {"failure_threshold": 3, "recovery_seconds": 5},
+      "capacity": {"constraint_counts": [10, 100],
+                   "rps_levels": [25, 50, 100, 200],
+                   "probe_s": 3},
+      "events": [
+        {"at": 0,  "action": "phase", "name": "steady"},
+        {"at": 62, "action": "add_constraints", "count": 50},
+        {"at": 86, "action": "arm_fault",
+         "point": "driver.device_dispatch", "mode": "error"},
+        {"at": 100, "action": "disarm_faults"},
+        {"at": 115, "action": "rotate_certs"},
+        {"at": 121, "action": "kill_replica", "replica": 0},
+      ]
+    }
+
+`phase` events label every subsequent reporting window until the next
+`phase` event — the reporter aggregates SLO attainment, shed and 5xx
+rates per phase, which is how the acceptance checks (fault window
+recovers, churn stays 5xx-free, replica kill sheds bounded) find their
+windows.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+PLANES = ("validation", "mutation", "agent")
+
+# action -> required extra keys (beyond "at"/"action")
+ACTIONS: Dict[str, tuple] = {
+    "phase": ("name",),          # label windows from here on
+    "add_constraints": (),       # count (default 25): constraint churn
+    "add_template": (),          # new template kind + one constraint
+    "add_provider": (),          # register another stub-backed provider
+    "add_mutator": (),           # add an AssignMetadata mutator
+    "arm_fault": ("point",),     # mode/count/after/delay ride along
+    "disarm_faults": (),         # reset the whole fault registry
+    "rotate_certs": (),          # force a cert rotation (tls only)
+    "kill_replica": (),          # replica (default 0): LB-out + drain
+}
+
+
+@dataclass
+class ScenarioEvent:
+    at_s: float
+    action: str
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ScenarioEvent":
+        if not isinstance(d, dict):
+            raise ValueError(f"event must be an object, got {d!r}")
+        action = d.get("action")
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown scenario action {action!r} "
+                f"(want one of {sorted(ACTIONS)})"
+            )
+        try:
+            at_s = float(d.get("at", 0.0))
+        except (TypeError, ValueError):
+            raise ValueError(f"event 'at' must be a number: {d!r}")
+        if at_s < 0:
+            raise ValueError(f"event 'at' must be >= 0: {d!r}")
+        params = {k: v for k, v in d.items() if k not in ("at", "action")}
+        for req in ACTIONS[action]:
+            if req not in params:
+                raise ValueError(
+                    f"scenario action {action!r} requires {req!r}: {d!r}"
+                )
+        return cls(at_s=at_s, action=action, params=params)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"at": self.at_s, "action": self.action, **self.params}
+
+
+@dataclass
+class Scenario:
+    name: str = "soak"
+    duration_s: float = 60.0
+    rps: float = 50.0
+    deadline_s: float = 0.25
+    window_s: float = 5.0
+    seed: int = 1234
+    replicas: int = 1
+    tls: bool = False
+    constraints: int = 20
+    external_keys: int = 12
+    violating_fraction: float = 0.1
+    # micro-batch window for the replicas' batchers
+    window_ms: float = 2.0
+    # override the driver's adaptive small-batch floor for the run
+    # (GATEKEEPER_TPU_MIN_DEVICE_BATCH equivalent): at realistic soak
+    # arrival rates micro-batches are small, and without lowering the
+    # floor every batch would take the interpreter route — device
+    # faults would never fire and the device-time split would be empty.
+    # None keeps the deployment default.
+    min_device_batch: Optional[int] = None
+    planes: Dict[str, float] = field(
+        default_factory=lambda: {
+            "validation": 0.7, "mutation": 0.15, "agent": 0.15
+        }
+    )
+    breaker: Dict[str, float] = field(
+        default_factory=lambda: {
+            "failure_threshold": 3, "recovery_seconds": 5.0
+        }
+    )
+    capacity: Optional[Dict[str, Any]] = None
+    events: List[ScenarioEvent] = field(default_factory=list)
+
+    def validate(self) -> None:
+        if self.duration_s <= 0:
+            raise ValueError("duration_s must be > 0")
+        if self.rps <= 0:
+            raise ValueError("rps must be > 0")
+        if self.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
+        if not (0 < self.window_s <= self.duration_s):
+            raise ValueError("window_s must be in (0, duration_s]")
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        for plane in self.planes:
+            if plane not in PLANES:
+                raise ValueError(
+                    f"unknown plane {plane!r} (want {PLANES})"
+                )
+        if sum(self.planes.values()) <= 0:
+            raise ValueError("plane weights must sum to > 0")
+        for ev in self.events:
+            if ev.at_s > self.duration_s:
+                raise ValueError(
+                    f"event at t={ev.at_s}s is past duration_s="
+                    f"{self.duration_s}s: {ev.to_dict()}"
+                )
+            if ev.action == "kill_replica":
+                idx = int(ev.params.get("replica", 0))
+                if not (0 <= idx < self.replicas):
+                    raise ValueError(
+                        f"kill_replica index {idx} out of range for "
+                        f"{self.replicas} replicas"
+                    )
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Scenario":
+        known = {
+            "name", "duration_s", "rps", "deadline_s", "window_s",
+            "seed", "replicas", "tls", "constraints", "external_keys",
+            "violating_fraction", "window_ms", "min_device_batch",
+            "planes", "breaker", "capacity", "events",
+        }
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown scenario keys: {sorted(unknown)}"
+            )
+        kwargs = {k: v for k, v in d.items() if k != "events"}
+        events = [ScenarioEvent.from_dict(e) for e in d.get("events", [])]
+        scn = cls(**kwargs, events=sorted(events, key=lambda e: e.at_s))
+        scn.validate()
+        return scn
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "duration_s": self.duration_s,
+            "rps": self.rps,
+            "deadline_s": self.deadline_s,
+            "window_s": self.window_s,
+            "seed": self.seed,
+            "replicas": self.replicas,
+            "tls": self.tls,
+            "constraints": self.constraints,
+            "external_keys": self.external_keys,
+            "violating_fraction": self.violating_fraction,
+            "window_ms": self.window_ms,
+            "min_device_batch": self.min_device_batch,
+            "planes": dict(self.planes),
+            "breaker": dict(self.breaker),
+            "capacity": self.capacity,
+            "events": [e.to_dict() for e in self.events],
+        }
+
+
+def load_scenario(path: str) -> Scenario:
+    with open(path) as f:
+        return Scenario.from_dict(json.load(f))
+
+
+def smoke_scenario() -> Scenario:
+    """The ~10 s tier-1 smoke: one replica, plain HTTP, a constraint-
+    churn blip and one fault window with a fast-recovery breaker —
+    enough to exercise every moving part of the harness without
+    minutes of wall clock."""
+    return Scenario.from_dict({
+        "name": "soak-smoke",
+        "duration_s": 10.0,
+        "rps": 30.0,
+        "deadline_s": 0.5,
+        "window_s": 1.0,
+        "seed": 99,
+        "replicas": 1,
+        "tls": False,
+        "constraints": 8,
+        "external_keys": 5,
+        "breaker": {"failure_threshold": 3, "recovery_seconds": 1.0},
+        "events": [
+            {"at": 0.0, "action": "phase", "name": "steady"},
+            {"at": 2.0, "action": "phase", "name": "churn"},
+            {"at": 2.2, "action": "add_constraints", "count": 5},
+            {"at": 3.0, "action": "add_provider"},
+            {"at": 4.0, "action": "phase", "name": "fault"},
+            # batch_dispatch error trips the breaker at interpreter-
+            # route batch sizes too; the host-rung hang (> deadline)
+            # makes the SLO dip measurable in a 2 s window
+            {"at": 4.1, "action": "arm_fault",
+             "point": "webhook.batch_dispatch", "mode": "error"},
+            {"at": 4.1, "action": "arm_fault",
+             "point": "webhook.host_review", "mode": "hang",
+             "delay": 0.6},
+            {"at": 6.0, "action": "disarm_faults"},
+            # the backlog the hang built drains during the tail of the
+            # fault phase; recovery is judged from t=7 so it measures
+            # the recovered system, not the queue flush
+            {"at": 7.0, "action": "phase", "name": "recovery"},
+        ],
+    })
+
+
+def default_scenario() -> Scenario:
+    """The full evidence run behind SOAK_r01.json: two TLS replicas
+    sharing a fleet cert Secret and cache/breaker gossip, >= 60 s of
+    steady open-loop load for the leak curves, then churn
+    (constraints + template + provider + mutator adds), a fault window
+    (device faults trip the breaker while the host rung stalls — the
+    SLO must degrade and then recover post-disarm), a live cert
+    rotation, and a graceful replica kill that replica B absorbs."""
+    return Scenario.from_dict({
+        "name": "soak-default",
+        "duration_s": 150.0,
+        "rps": 60.0,
+        "deadline_s": 0.25,
+        "window_s": 5.0,
+        "seed": 1234,
+        "replicas": 2,
+        "tls": True,
+        "constraints": 30,
+        "external_keys": 12,
+        # realistic arrival rates make small micro-batches: lower the
+        # device floor so the run exercises the REAL fused path (and
+        # device faults actually fire; see Scenario.min_device_batch)
+        "window_ms": 10.0,
+        "min_device_batch": 2,
+        "breaker": {"failure_threshold": 3, "recovery_seconds": 5.0},
+        "capacity": {
+            "constraint_counts": [10, 100],
+            "rps_levels": [25, 50, 100, 200, 400],
+            "probe_s": 3.0,
+        },
+        "events": [
+            {"at": 0.0, "action": "phase", "name": "steady"},
+            {"at": 60.0, "action": "phase", "name": "churn"},
+            {"at": 62.0, "action": "add_constraints", "count": 50},
+            {"at": 66.0, "action": "add_template"},
+            {"at": 70.0, "action": "add_provider"},
+            {"at": 74.0, "action": "add_mutator"},
+            {"at": 85.0, "action": "phase", "name": "fault"},
+            {"at": 86.0, "action": "arm_fault",
+             "point": "driver.device_dispatch", "mode": "error"},
+            {"at": 86.0, "action": "arm_fault",
+             "point": "webhook.host_review", "mode": "hang",
+             "delay": 0.35},
+            {"at": 100.0, "action": "disarm_faults"},
+            # recovery judged after the hang-built backlog drains
+            {"at": 105.0, "action": "phase", "name": "recovery"},
+            {"at": 115.0, "action": "rotate_certs"},
+            {"at": 120.0, "action": "phase", "name": "kill"},
+            {"at": 121.0, "action": "kill_replica", "replica": 0},
+        ],
+    })

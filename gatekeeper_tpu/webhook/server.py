@@ -517,9 +517,25 @@ class WebhookServer:
         # and rewrites tool-call arguments before validation
         agent_review: bool = False,
         agent_mutation_system=None,
+        # graceful drain (docs/robustness.md): seconds stop() holds the
+        # listener OPEN after flipping readiness, so a load balancer
+        # watching /readyz routes away before connections start failing
+        # (the preStop-sleep pattern; 0 = flip-and-close immediately)
+        drain_grace_s: float = 0.0,
     ):
         self.client = client  # warmup() compiles through it
         self.tracer = tracer
+        self.request_timeout = request_timeout
+        self.drain_grace_s = drain_grace_s
+        # graceful-drain state: `draining` flips BEFORE the listener
+        # closes (readiness consults it), in-flight HTTP requests are
+        # counted so stop() can wait for them, and on_drain callbacks
+        # let the control plane (Runner readyz, a soak harness's LB
+        # model) observe the flip at its exact ordering point
+        self.draining = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._drain_callbacks: List[Callable[[], None]] = []
         self.batcher = MicroBatcher(
             client, target, window_ms=window_ms,
             namespace_getter=namespace_getter,
@@ -587,6 +603,19 @@ class WebhookServer:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802
+                # in-flight accounting: an ACCEPTED request must finish
+                # even when stop() runs concurrently — the drain waits
+                # on this counter before tearing the batchers down
+                with outer._inflight_cv:
+                    outer._inflight += 1
+                try:
+                    self._do_post()
+                finally:
+                    with outer._inflight_cv:
+                        outer._inflight -= 1
+                        outer._inflight_cv.notify_all()
+
+            def _do_post(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 try:
@@ -735,8 +764,62 @@ class WebhookServer:
         self.warm = True
         return time.monotonic() - t0
 
-    def stop(self) -> None:
+    # -- graceful drain (docs/robustness.md) ---------------------------------
+
+    @property
+    def ready(self) -> bool:
+        """Serving readiness: False from the instant a drain begins —
+        the signal a load balancer / kubelet readiness probe needs
+        BEFORE the listener goes away."""
+        return not self.draining
+
+    def on_drain(self, callback: Callable[[], None]) -> None:
+        """Register a callback fired when draining flips (before the
+        listener closes). Used by the Runner's readyz plane and by
+        harnesses modeling a load balancer."""
+        self._drain_callbacks.append(callback)
+
+    def begin_drain(self) -> None:
+        """Flip not-ready. Idempotent; does NOT close anything — the
+        listener keeps accepting (and the batchers keep evaluating)
+        until stop() proceeds, so a request racing the flip still gets
+        a real answer instead of a reset."""
+        if self.draining:
+            return
+        self.draining = True
+        for cb in list(self._drain_callbacks):
+            try:
+                cb()
+            except Exception:
+                pass  # observers must not be able to wedge the drain
+
+    def _await_inflight(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._inflight_cv:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cv.wait(timeout=min(remaining, 0.1))
+        return True
+
+    def stop(self, drain_grace_s: Optional[float] = None) -> None:
+        """Graceful shutdown, in the only order that sheds zero
+        accepted requests: (1) readiness flips not-ready, (2) the
+        drain grace lets the LB react while the listener still
+        accepts, (3) the listener closes, (4) in-flight requests —
+        which block on batch futures — complete because the batchers
+        are STILL RUNNING, and only then (5) the batchers stop
+        (dispatching any leftovers inline) and the socket is released.
+        A SIGTERM mid-load therefore answers everything it accepted."""
+        grace = self.drain_grace_s if drain_grace_s is None else drain_grace_s
+        self.begin_drain()
+        if grace > 0:
+            time.sleep(grace)
         self._httpd.shutdown()
+        # bounded by the request envelope: no accepted request can
+        # legitimately outlive its own timeout + a dispatch window
+        self._await_inflight(min(self.request_timeout + 1.0, 15.0))
         self.batcher.stop()
         if self.mutate_batcher is not None:
             self.mutate_batcher.stop()
@@ -747,3 +830,9 @@ class WebhookServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        # release the listening socket fd: a soak that restarts
+        # replicas repeatedly must not leak one fd per lifecycle
+        try:
+            self._httpd.server_close()
+        except Exception:
+            pass

@@ -524,6 +524,11 @@ _GATE_ALLOWED = {
     # match.excludedNamespaces) — the K8s control plane's own CR, reached
     # by the webhook only through TargetHandler.request_exempt
     "control/process.py",
+    # the soak harness is a CLIENT of the K8s target: it synthesizes
+    # K8s-shaped AdmissionRequests/constraints as load (the same role
+    # bench_webhook.py plays outside the package) — it consumes the
+    # target's public schema, it does not bypass the boundary
+    "soak/harness.py",
 }
 # modules allowed to import the match-semantics engine directly (the
 # boundary, the engine's own internals, and public re-exports)

@@ -229,6 +229,120 @@ def test_breaker_trip_halfopen_probe_recover():
     assert gauges.get('device_breaker_state{plane="validation"}') == 0
 
 
+def test_breaker_full_second_cycle_accounting():
+    """Long-run soak accounting: a complete CLOSED→OPEN→HALF_OPEN→
+    CLOSED cycle followed by a SECOND trip keeps every counter exact —
+    no double-counted transitions, no stuck HALF_OPEN, and fleet
+    adopt() stays consistent across the cycles."""
+    metrics = MetricsRegistry()
+    clock = [0.0]
+    b = CircuitBreaker(
+        failure_threshold=3, recovery_seconds=10.0, metrics=metrics,
+        clock=lambda: clock[0],
+    )
+    seen = []
+    b.subscribe(lambda f, t: seen.append((f, t)))
+
+    # cycle 1: trip, wait out recovery, probe succeeds
+    for _ in range(3):
+        b.record_failure()
+    clock[0] = 10.5
+    assert b.allow()  # half-open probe
+    b.record_success()
+    assert b.state == CLOSED
+    # cycle 2: trip again, probe FAILS once, then recovers
+    for _ in range(3):
+        b.record_failure()
+    clock[0] = 21.0
+    assert b.allow()
+    assert not b.allow()  # single-probe invariant holds on cycle 2
+    b.record_failure()  # probe fails: back to OPEN, clock restarts
+    assert b.state == OPEN
+    clock[0] = 30.0  # only 9s since re-open: still OPEN
+    assert not b.allow()
+    clock[0] = 31.5
+    assert b.allow()  # half-open again — never stuck
+    b.record_success()
+    assert b.state == CLOSED
+    # exact transition ledger: 2 full cycles + 1 failed probe re-open
+    expected = [
+        (CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED),
+        (CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, OPEN),
+        (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED),
+    ]
+    assert seen == expected
+    assert b.transitions == len(expected)
+    assert counter(
+        metrics, "device_breaker_transitions_total",
+        plane="validation", from_state="closed", to_state="open",
+    ) == 2
+    assert counter(
+        metrics, "device_breaker_transitions_total",
+        plane="validation", from_state="half_open", to_state="closed",
+    ) == 2
+    assert counter(
+        metrics, "device_breaker_transitions_total",
+        plane="validation", from_state="half_open", to_state="open",
+    ) == 1
+    assert counter(
+        metrics, "device_breaker_probes_total",
+        plane="validation", result="success",
+    ) == 2
+    assert counter(
+        metrics, "device_breaker_probes_total",
+        plane="validation", result="failure",
+    ) == 1
+    snap = b.snapshot()
+    assert snap["state"] == CLOSED
+    assert snap["consecutive_failures"] == 0
+    assert not snap["probe_in_flight"]
+
+
+def test_breaker_adopt_consistent_across_cycles():
+    """Fleet adopt() across a full local cycle: adoptions count once
+    per real transition, never re-fire on a no-op peer hint, and an
+    adopted HALF_OPEN can complete its own probe cycle."""
+    clock = [0.0]
+    b = CircuitBreaker(
+        failure_threshold=3, recovery_seconds=10.0,
+        clock=lambda: clock[0],
+    )
+    # peer OPEN while CLOSED: pre-open to HALF_OPEN, counted once
+    assert b.adopt(OPEN)
+    assert b.state == HALF_OPEN and b.adoptions == 1
+    # repeated peer gossip of the same state is a no-op (no
+    # double-count, no state churn)
+    assert not b.adopt(OPEN)
+    assert not b.adopt(HALF_OPEN)
+    assert b.adoptions == 1 and b.transitions == 1
+    # the adopted HALF_OPEN still enforces the single-probe contract
+    assert b.allow()
+    assert not b.allow()
+    b.record_success()
+    assert b.state == CLOSED
+    # peer CLOSED while CLOSED: nothing to adopt
+    assert not b.adopt(CLOSED)
+    # second cycle: a real local trip, then peer CLOSED pulls the
+    # probe forward instead of waiting out recovery_seconds
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == OPEN
+    assert b.adopt(CLOSED)
+    assert b.state == HALF_OPEN and b.adoptions == 2
+    assert b.allow()
+    b.record_failure()  # probe disagrees with the peer: re-open
+    assert b.state == OPEN
+    # the failed probe restarted the recovery clock; adopt still works
+    assert b.adopt(CLOSED)
+    assert b.state == HALF_OPEN
+    b.record_success()
+    assert b.state == CLOSED
+    assert b.adoptions == 3
+    snap = b.snapshot()
+    assert snap["adoptions"] == 3
+    assert snap["consecutive_failures"] == 0
+
+
 # -- the degradation ladder (fused -> host -> policy envelope) ---------------
 
 
