@@ -516,6 +516,259 @@ def run_chaos_bench(n_requests=3000, n_constraints=20, err=sys.stderr):
     }
 
 
+def build_partition_client(driver, n_constraints):
+    """Policy load for the --partitions lane: ONE template, n
+    constraints named w000..wNNN (zero-padded so the driver's sorted
+    identity order is numeric), constraint j matching ONLY namespace
+    part-ns-<j % 4>. Round-robin partitioning over the sorted identity
+    list puts global index j in partition j % k — so with k=4 every
+    partition's constraints match exactly one namespace, and the bench
+    can address one fault domain with one namespace."""
+    from gatekeeper_tpu.constraint import Backend, K8sValidationTarget
+
+    client = Backend(driver).new_client(K8sValidationTarget())
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "partbench"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "PartBench"}}},
+            "targets": [{
+                "target": TARGET,
+                "rego": _CHAOS_REGO.replace("chaosbench", "partbench"),
+            }],
+        },
+    })
+    for i in range(n_constraints):
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "PartBench",
+            "metadata": {"name": f"w{i:03d}"},
+            "spec": {"match": {
+                "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+                "namespaces": [f"part-ns-{i % 4}"],
+            }},
+        })
+    return client
+
+
+def part_request(i, ns_idx, violating=True):
+    r = make_request(i, violating)
+    ns = f"part-ns-{ns_idx}"
+    r["namespace"] = ns
+    r["object"]["metadata"]["namespace"] = ns
+    if "oldObject" in r:
+        r["oldObject"]["metadata"]["namespace"] = ns
+    return r
+
+
+def _normalize_results(results):
+    return [
+        (
+            r.constraint.get("kind"),
+            (r.constraint.get("metadata") or {}).get("name"),
+            r.msg,
+        )
+        for r in results
+    ]
+
+
+def run_partitions_bench(n_requests=2000, n_constraints=40, k=4,
+                         err=sys.stderr):
+    """The `--partitions` lane (docs/robustness.md §Fault domains):
+    partitioned program dispatch with per-device breakers and
+    quarantine. Phases: per-partition fused latency, a healthy-subsets
+    phase with ONE device faulted (requests matching only healthy
+    partitions must show ZERO degraded dispatches), a mixed sick-device
+    phase (degraded coverage fraction + time to re-homed full fused
+    coverage), and post-disarm recovery (probe heals, plan restores
+    home devices). Also spot-checks partition parity: merged
+    per-partition verdicts == the monolithic dispatch."""
+    from gatekeeper_tpu.constraint import TpuDriver
+    from gatekeeper_tpu.faults import FAULTS, device_point
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.parallel.partition import (
+        PartitionDispatcher,
+        merge_partition_results,
+    )
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    metrics = MetricsRegistry()
+    client = build_partition_client(TpuDriver(), n_constraints)
+    disp = PartitionDispatcher(
+        client, TARGET, k=k, metrics=metrics,
+        failure_threshold=3, recovery_seconds=1.0,
+    )
+    batcher = MicroBatcher(
+        client, TARGET, window_ms=2.0, metrics=metrics,
+        max_queue=512, partitioner=disp,
+    )
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=10, metrics=metrics, fail_policy="open"
+    )
+    n_sub = max(256, n_requests // 6)
+    phases = []
+    deg_key = 'webhook_degraded_dispatch_total{plane="validation"}'
+
+    def run_phase(name, requests, concurrency=64):
+        d0 = dict(disp.dispatches)
+        deg0 = metrics.snapshot()["counters"].get(deg_key, 0)
+        r = replay(handler, requests, concurrency)
+        d1 = disp.dispatches
+        deltas = {
+            route: d1.get(route, 0) - d0.get(route, 0)
+            for route in ("fused", "host", "failed", "skipped")
+        }
+        total = deltas["fused"] + deltas["host"] + deltas["failed"]
+        r.update(
+            phase=name,
+            partition_dispatches=deltas,
+            degraded_dispatches=(
+                metrics.snapshot()["counters"].get(deg_key, 0) - deg0
+            ),
+            degraded_coverage_fraction=round(
+                (deltas["host"] + deltas["failed"]) / total, 4
+            ) if total else 0.0,
+            quarantined=list(disp.snapshot()["quarantined"]),
+        )
+        phases.append(r)
+        print(f"partitions phase: {r}", file=err)
+        return r
+
+    def mixed(n, start=0):
+        return [part_request(start + i, i % 4) for i in range(n)]
+
+    batcher.start()
+    try:
+        _warm_route(client)
+        plan = disp.plan()
+        for p in plan.partitions:
+            disp.ensure_staged(p)
+        # warm each partition's sub-program kernels off the clock
+        warm_reviews = [
+            batcher.target_handler.augment_request(r)
+            for r in mixed(32)
+        ]
+        for p in plan.partitions:
+            client.review_many_subset(warm_reviews, p.subset,
+                                      device=p.device)
+        # parity spot check: merged partitioned == monolithic, request
+        # by request (the full property battery lives in the chaos lane)
+        mono = client.review_many(warm_reviews)
+        per_part = [
+            client.review_many_subset(warm_reviews, p.subset,
+                                      device=p.device)
+            for p in plan.partitions
+        ]
+        parity_ok = True
+        for i in range(len(warm_reviews)):
+            merged = merge_partition_results(
+                [
+                    (pp[i].by_target.get(TARGET).results
+                     if TARGET in pp[i].by_target else [])
+                    for pp in per_part
+                ],
+                plan.order,
+            )
+            expect = (
+                mono[i].by_target[TARGET].results
+                if TARGET in mono[i].by_target else []
+            )
+            if _normalize_results(merged) != _normalize_results(expect):
+                parity_ok = False
+        # per-partition fused latency (direct subset dispatch)
+        per_partition = []
+        for p in plan.partitions:
+            lat = []
+            for _ in range(12):
+                t0 = time.perf_counter()
+                client.review_many_subset(warm_reviews, p.subset,
+                                          device=p.device)
+                lat.append(time.perf_counter() - t0)
+            per_partition.append({
+                "partition": p.index,
+                "device": p.device,
+                "constraints": len(p.keys),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+            })
+            print(f"partition {p.index}: {per_partition[-1]}", file=err)
+
+        run_phase("fused_healthy", mixed(n_sub))
+        # ONE device sick; requests that match only HEALTHY partitions
+        # must pay nothing: zero degraded dispatches, zero host routes
+        FAULTS.arm(device_point("driver.device_dispatch", 1),
+                   mode="error")
+        t_fault = time.monotonic()
+        healthy = run_phase(
+            "sick_device_healthy_subsets",
+            [part_request(i, [0, 2, 3][i % 3]) for i in range(n_sub)],
+        )
+        # mixed traffic: ns-1's subset degrades to host, the device-1
+        # breaker trips, quarantine re-homes its partition, and full
+        # fused coverage returns while the chip is still sick
+        recovery_s = None
+        waves = 0
+        fault0 = dict(disp.dispatches)
+        while waves < 40:
+            d0 = dict(disp.dispatches)
+            replay(handler, mixed(128, start=waves * 128), 64)
+            waves += 1
+            degraded = (
+                disp.dispatches.get("host", 0) - d0.get("host", 0)
+                + disp.dispatches.get("failed", 0) - d0.get("failed", 0)
+            )
+            if degraded == 0:
+                recovery_s = round(time.monotonic() - t_fault, 3)
+                break
+        fault1 = dict(disp.dispatches)
+        fault_deltas = {
+            route: fault1.get(route, 0) - fault0.get(route, 0)
+            for route in ("fused", "host", "failed")
+        }
+        fault_total = sum(fault_deltas.values())
+        fault_coverage = (
+            round(
+                (fault_deltas["host"] + fault_deltas["failed"])
+                / fault_total, 4,
+            )
+            if fault_total else 0.0
+        )
+        run_phase("sick_device_rehomed", mixed(n_sub))
+        # disarm: the quarantined device's half-open probe heals it and
+        # the plan restores the home assignment
+        FAULTS.reset()
+        time.sleep(1.2)
+        run_phase("recovered", mixed(n_sub))
+        restored = all(
+            p.device == p.home_device
+            for p in disp.plan().partitions
+        )
+    finally:
+        batcher.stop()
+        disp.close()
+        FAULTS.reset()
+    return {
+        "partitions": k,
+        "constraints": n_constraints,
+        "plan": disp.snapshot()["plan"],
+        "per_partition": per_partition,
+        "parity_ok": parity_ok,
+        "healthy_subset_degraded": (
+            healthy["degraded_dispatches"]
+            + healthy["partition_dispatches"]["host"]
+        ),
+        "degraded_coverage_fraction": fault_coverage,
+        "recovery_s": recovery_s,
+        "home_restored": restored,
+        "dispatcher": disp.snapshot(),
+        "phases": phases,
+    }
+
+
 _EXTERNAL_REGO = """package externalbench
 
 violation[{"msg": msg}] {
@@ -1198,7 +1451,11 @@ def _summarize(mode, res):
                       "hit_rate", "fetches_per_batch",
                       "fetches_per_key_n1", "fetches_per_key_n2_isolated",
                       "fetches_per_key_n2_fleet",
-                      "cold_fetch_amplification"):
+                      "cold_fetch_amplification",
+                      "partitions", "parity_ok",
+                      "healthy_subset_degraded",
+                      "degraded_coverage_fraction", "recovery_s",
+                      "home_restored"):
                 if k in res:
                     head[k] = res[k]
     except Exception as e:  # the summary must never kill the artifact
@@ -1263,6 +1520,14 @@ if __name__ == "__main__":
         res = run_chaos_bench(n_req, n_con)
         print(json.dumps(res))
         print(_summarize("chaos", res))
+    elif "--partitions" in sys.argv:
+        pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n_req = int(pos[0]) if pos else 2_000
+        n_con = int(pos[1]) if len(pos) > 1 else 40
+        n_parts = int(pos[2]) if len(pos) > 2 else 4
+        res = run_partitions_bench(n_req, n_con, n_parts)
+        print(json.dumps(res))
+        print(_summarize("partitions", res))
     elif "--external" in sys.argv:
         pos = [a for a in sys.argv[1:] if not a.startswith("--")]
         n_req = int(pos[0]) if pos else 3_000

@@ -393,6 +393,77 @@ class Client:
                 out[i].by_target[name] = resp
         return out
 
+    def review_many_subset(
+        self, objs: Sequence[Any], subset, device: int = 0
+    ) -> List[Responses]:
+        """Partition-scoped batched review (docs/robustness.md §Fault
+        domains): one driver dispatch evaluating ONLY `subset`'s
+        constraints (keys per `driver.constraint_key`), attributed to
+        logical `device`. The partitioned MicroBatcher fans a batch out
+        over a PartitionPlan's subsets and merges the per-partition
+        results back into the monolithic order."""
+        out: List[Responses] = [Responses() for _ in objs]
+        for name, handler in self.targets.items():
+            idxs: List[int] = []
+            inputs: List[Any] = []
+            for i, obj in enumerate(objs):
+                handled, review = handler.handle_review(obj)
+                if not handled:
+                    continue
+                idxs.append(i)
+                inputs.append({"review": review})
+            if not inputs:
+                continue
+            resps = self._driver.query_many_subset(
+                f'hooks["{name}"].violation', inputs, subset, device=device
+            )
+            for i, resp in zip(idxs, resps):
+                for r in resp.results:
+                    handler.handle_violation(r)
+                resp.target = name
+                out[i].by_target[name] = resp
+        return out
+
+    def partition_match_mask(
+        self, objs: Sequence[Any], subsets: Sequence[Any]
+    ) -> List[List[bool]]:
+        """Per-(partition, request) match screen: True iff the request
+        could produce any result from that subset's constraints. The
+        partitioned batcher skips partitions nothing in the batch
+        touches and scopes the degraded host rung to affected requests
+        only (the blast-radius contract)."""
+        masks = [[False] * len(objs) for _ in subsets]
+        for name, handler in self.targets.items():
+            idxs: List[int] = []
+            inputs: List[Any] = []
+            for i, obj in enumerate(objs):
+                handled, review = handler.handle_review(obj)
+                if not handled:
+                    continue
+                idxs.append(i)
+                inputs.append({"review": review})
+            if not inputs:
+                continue
+            target_masks = self._driver.partition_match_mask(
+                f'hooks["{name}"].violation', inputs, subsets
+            )
+            for p, tmask in enumerate(target_masks):
+                for j, i in enumerate(idxs):
+                    masks[p][i] = masks[p][i] or tmask[j]
+        return masks
+
+    def prepare_subset(self, subset, device: int = 0) -> bool:
+        """Stage one partition's sub-program for every target (the
+        quarantine re-home restage step; FaultError from the
+        device-labeled restage point propagates so the dispatcher can
+        back off)."""
+        prep = getattr(self._driver, "prepare_subset", None)
+        if prep is None:
+            return True
+        for name in self.targets:
+            prep(f'hooks["{name}"].violation', subset, device=device)
+        return True
+
     def prefetch_external(self, objs: Sequence[Any]) -> None:
         """Batch-plane external-data prefetch for a review batch that
         will evaluate per-request (the host-interpreter rung): opens a
@@ -450,20 +521,23 @@ class Client:
         except Exception:
             pass
 
-    def review_host(self, obj: Any) -> Responses:
+    def review_host(self, obj: Any, subset=None) -> Responses:
         """Host-interpreter review: the degraded rung of the admission
         ladder (docs/robustness.md). Same results as `review` by the
         driver-parity contract, but pinned to the host so a faulted
         device path is never re-attempted per request — the micro-batch
         worker calls this when the fused dispatch fails or the circuit
-        breaker is open."""
+        breaker is open. `subset` scopes the evaluation to one
+        partition's constraints (§Fault domains): a sick device
+        degrades only its own constraint subset to the interpreter."""
         responses = Responses()
         for name, handler in self.targets.items():
             handled, review = handler.handle_review(obj)
             if not handled:
                 continue
             resp = self._driver.query_host(
-                f'hooks["{name}"].violation', {"review": review}
+                f'hooks["{name}"].violation', {"review": review},
+                subset=subset,
             )
             for r in resp.results:
                 handler.handle_violation(r)

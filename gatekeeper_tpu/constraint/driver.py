@@ -42,6 +42,20 @@ from .types import Response, Result
 
 _HOOK_RE = re.compile(r'^hooks\["([^"]+)"\]\.(violation|audit)$')
 
+# the autoreject message is also the marker the partition merge uses to
+# keep autoreject results ahead of evaluation results (parallel/
+# partition.py mirrors the monolithic emit order exactly)
+AUTOREJECT_MSG = "Namespace is not cached in OPA."
+
+
+def constraint_key(constraint: Dict[str, Any]) -> str:
+    """The stable identity of a constraint — `<kind>/<name>` — used by
+    the partition plane to address constraint subsets. `_constraints`'
+    (kind, name) sort order makes the sorted key list the global result
+    order partitioned dispatch merges back into."""
+    meta = constraint.get("metadata") or {}
+    return f"{constraint.get('kind', '?')}/{meta.get('name', '?')}"
+
 
 def _autoreject_result(constraint: Dict[str, Any], review: Any) -> Result:
     """The autoreject Result shape (client/regolib/src.go:7-21) — the ONE
@@ -49,7 +63,7 @@ def _autoreject_result(constraint: Dict[str, Any], review: Any) -> Result:
     adaptive small-batch, fused device batch): driver parity demands the
     shape can never diverge between routes."""
     return Result(
-        msg="Namespace is not cached in OPA.",
+        msg=AUTOREJECT_MSG,
         metadata={"details": {}},
         constraint=constraint,
         review=review,
@@ -94,13 +108,28 @@ class Driver(ABC):
         micro-batching webhook's entry point)."""
         return [self.query(path, i, tracing) for i in inputs]
 
-    def query_host(self, path: str, input: Any = None) -> Response:
+    def query_host(
+        self, path: str, input: Any = None, subset=None
+    ) -> Response:
         """Host-only query: the degraded rung of the admission ladder
         (docs/robustness.md). Engines whose `query` already runs on the
         host inherit this; the TPU driver overrides it to pin the
         evaluation to the interpreter so a faulted device is never paid
-        a second doomed attempt."""
+        a second doomed attempt. `subset` (constraint keys, see
+        `constraint_key`) scopes the evaluation to one partition's
+        constraints — the fault-domain degraded rung evaluates ONLY the
+        failed partition's subset on the host."""
         return self.query(path, input)
+
+    def query_many_subset(
+        self, path: str, inputs: Sequence[Any], subset, device: int = 0
+    ) -> List[Response]:
+        """Partition-scoped batched query (docs/robustness.md §Fault
+        domains): evaluate only `subset`'s constraints for every input.
+        Engines without a device path evaluate the subset serially on
+        the host; the TPU driver overrides with a fused sub-program
+        dispatch placed on logical `device`."""
+        return [self.query_host(path, i, subset=subset) for i in inputs]
 
     @abstractmethod
     def dump(self) -> str: ...
@@ -240,6 +269,20 @@ class RegoDriver(Driver):
                     out.append(c)
         return out
 
+    def constraint_keys(self, target: str) -> List[str]:
+        """Sorted `<kind>/<name>` identities of every constraint — the
+        global order partitioned dispatch merges back into, and the
+        corpus a PartitionPlan splits (parallel/partition.py)."""
+        with self._mutex:
+            return [constraint_key(c) for c in self._constraints(target)]
+
+    def constraint_generation(self) -> int:
+        """Monotonic constraint-churn signal: the partition plane
+        rebuilds its plan when this moves. The base driver bumps its
+        data version on every write (over-eager but sound); the TPU
+        driver narrows it to actual constraint/template churn."""
+        return self._data_version
+
     def _ns_cache(self, target: str) -> Dict[str, Any]:
         """The target's review-context cache (K8s: synced Namespaces);
         resolution is the handler's, the storage accessor ours."""
@@ -259,12 +302,85 @@ class RegoDriver(Driver):
         self._frozen_inv[target] = (self._data_version, frozen)
         return frozen
 
+    def query_host(
+        self, path: str, input: Any = None, subset=None
+    ) -> Response:
+        """Interpreter evaluation (this engine's query IS host-side),
+        optionally scoped to a constraint subset (the fault-domain
+        degraded rung: only the failed partition's constraints are
+        re-evaluated on the host, docs/robustness.md §Fault domains)."""
+        if subset is None:
+            return self.query(path, input)
+        m = _HOOK_RE.match(path)
+        if m is None or m.group(2) != "violation":
+            raise ValueError(f"unsupported subset query path: {path!r}")
+        target = m.group(1)
+        sub = frozenset(subset)
+        with self._mutex:
+            constraints = [
+                c for c in self._constraints(target)
+                if constraint_key(c) in sub
+            ]
+            results = RegoDriver._violation(
+                self, target, input or {}, None, constraints=constraints
+            )
+        return Response(target=target, results=results)
+
+    def partition_match_mask(
+        self, path: str, inputs: Sequence[Any], subsets: Sequence[Any]
+    ) -> List[List[bool]]:
+        """Per-(partition, input) match screen: mask[p][i] is True iff
+        input i could produce ANY result from subset p's constraints —
+        a real match, or an autoreject against a needs-context
+        constraint in the subset. The partitioned batcher uses it to
+        skip partitions no request in the batch touches (a faulted
+        partition whose constraints match nothing in the batch costs
+        the batch NOTHING — the blast-radius contract) and to scope the
+        degraded host rung to affected requests only."""
+        m = _HOOK_RE.match(path)
+        if m is None or m.group(2) != "violation":
+            raise ValueError(f"unsupported mask query path: {path!r}")
+        target = m.group(1)
+        with self._mutex:
+            handler = self._handler(target)
+            constraints = self._constraints(target)
+            ns_cache = self._ns_cache(target)
+            by_key: Dict[str, List[Dict[str, Any]]] = {}
+            for c in constraints:
+                by_key.setdefault(constraint_key(c), []).append(c)
+            reviews = [
+                H.hook_get_default(i or {}, "review", {}) for i in inputs
+            ]
+            autorej = [
+                bool(constraints)
+                and handler.review_autorejects(r, ns_cache)
+                for r in reviews
+            ]
+            masks: List[List[bool]] = []
+            for subset in subsets:
+                sub = [c for k in sorted(subset) for c in by_key.get(k, ())]
+                needs_ctx = any(
+                    handler.constraint_needs_context(c) for c in sub
+                )
+                masks.append([
+                    (ar and needs_ctx)
+                    or any(
+                        handler.matches_constraint(c, r, ns_cache)
+                        for c in sub
+                    )
+                    for r, ar in zip(reviews, autorej)
+                ])
+            return masks
+
     def _violation(
-        self, target: str, input: Dict[str, Any], trace: Optional[List[str]]
+        self, target: str, input: Dict[str, Any],
+        trace: Optional[List[str]],
+        constraints: Optional[List[Dict[str, Any]]] = None,
     ) -> List[Result]:
         review = H.hook_get_default(input, "review", {})
         handler = self._handler(target)
-        constraints = self._constraints(target)
+        if constraints is None:
+            constraints = self._constraints(target)
         ns_cache = self._ns_cache(target)
         inventory = self._inventory(target)
         results: List[Result] = []

@@ -51,7 +51,7 @@ from ..analysis import (
     analyze_modules,
 )
 from ..engine.matchkernel import matchspec_to_np
-from ..faults import fire
+from ..faults import device_point, fire
 from ..engine.patterns import PatternRegistry
 from ..engine.programs import Program, ProgramEvaluator, compile_program
 from ..engine.symbolic import CompilerEnv, CompileUnsupported
@@ -67,7 +67,13 @@ from ..rego import ast as A
 from ..rego.interp import RegoError, Undefined, _call_function
 from ..rego.values import freeze, thaw
 from . import hooks as H
-from .driver import _HOOK_RE, RegoDriver, _autoreject_result, _cname
+from .driver import (
+    _HOOK_RE,
+    RegoDriver,
+    _autoreject_result,
+    _cname,
+    constraint_key,
+)
 from .types import Response, Result
 
 _TEMPLATE_PREFIX_RE = re.compile(r'^templates\["([^"]+)"\]\["([^"]+)"\]$')
@@ -231,6 +237,13 @@ class TpuDriver(RegoDriver):
         self._constraint_gen = 0
         self._corpus: Dict[str, _Corpus] = {}  # per target
         self._cset: Dict[str, _ConstraintSet] = {}
+        # partition-scoped constraint subsets (docs/robustness.md
+        # §Fault domains): (target, frozenset of constraint keys) ->
+        # independently staged/dispatchable _ConstraintSet. Bounded —
+        # plan churn (quarantine/re-home) mints new subsets and the
+        # stale ones must not pin device policy state forever.
+        self._cset_sub: Dict[Tuple[str, frozenset], _ConstraintSet] = {}
+        self._cset_sub_max = 64
         # rendered-pair cache for the persistent audit corpus: identical
         # (constraint, review, inventory) inputs render identical results,
         # so violating pairs that persist across sweeps skip the
@@ -553,6 +566,62 @@ class TpuDriver(RegoDriver):
             },
         )
         self._cset[target] = cs
+        return cs
+
+    def constraint_generation(self) -> int:
+        return self._constraint_gen
+
+    def _subset_cset(
+        self, target: str, subset: frozenset
+    ) -> Optional[_ConstraintSet]:
+        """Partition-scoped _ConstraintSet: only `subset`'s constraints,
+        with its own match tensors and (lazily staged) device policy —
+        the independently compilable/dispatchable sub-program behind one
+        fault domain. Programs come from the shared `_programs` cache
+        (a subset never re-compiles what the monolith compiled), and —
+        unlike `_constraint_set` — no program eviction runs here: the
+        subset view must never evict programs the full set still uses."""
+        key = (target, subset)
+        cs = self._cset_sub.get(key)
+        if cs is not None and cs.constraint_gen == self._constraint_gen:
+            return cs
+        constraints = [
+            c for c in self._constraints(target)
+            if constraint_key(c) in subset
+        ]
+        if not constraints:
+            self._cset_sub.pop(key, None)
+            return None
+        ms = self._handler(target).compile_match_specs(
+            constraints, self.vocab
+        )
+        programs = [self._program_for(target, c) for c in constraints]
+        prog_rows: List[int] = []
+        row = 0
+        for p in programs:
+            if p is None:
+                prog_rows.append(-1)
+            else:
+                prog_rows.append(row)
+                row += 1
+        fallback_codes = {
+            c["kind"]: self._fallback_codes.get((target, c["kind"]))
+            for c, p in zip(constraints, programs)
+            if p is None and isinstance(c.get("kind"), str)
+        }
+        cs = _ConstraintSet(
+            constraint_gen=self._constraint_gen,
+            constraints=constraints,
+            ms=matchspec_to_np(ms),
+            programs=programs,
+            prog_rows=prog_rows,
+            fallback_codes={
+                k: v or "GK-V007" for k, v in fallback_codes.items()
+            },
+        )
+        while len(self._cset_sub) >= self._cset_sub_max:
+            self._cset_sub.pop(next(iter(self._cset_sub)), None)
+        self._cset_sub[key] = cs
         return cs
 
     # -- corpus encoding -----------------------------------------------------
@@ -1211,23 +1280,128 @@ class TpuDriver(RegoDriver):
                 ]
         return self._query_many_device(target, inputs)
 
-    def query_host(self, path: str, input: Any = None) -> Response:
+    def query_host(
+        self, path: str, input: Any = None, subset=None
+    ) -> Response:
         """The host-oracle rung of the degradation ladder: evaluate on
         the INTERPRETER, never touching the device — the path the
         webhook's circuit breaker degrades to when the fused dispatch
         is failing (a faulted device must not be paid a second doomed
-        attempt per request). Results are bit-identical to the fused
-        path by the driver-parity contract."""
+        attempt per request). `subset` scopes the evaluation to one
+        partition's constraints (docs/robustness.md §Fault domains), so
+        a single sick device degrades ONLY its constraint subset to the
+        interpreter while every other partition stays fused. Results
+        are bit-identical to the fused path by the driver-parity
+        contract."""
         m = _HOOK_RE.match(path)
         if m is None:
             raise ValueError(f"unsupported query path: {path!r}")
         target, hook = m.group(1), m.group(2)
         with self._mutex:
             if hook == "violation":
-                results = RegoDriver._violation(self, target, input or {}, None)
+                constraints = None
+                if subset is not None:
+                    sub = frozenset(subset)
+                    constraints = [
+                        c for c in self._constraints(target)
+                        if constraint_key(c) in sub
+                    ]
+                results = RegoDriver._violation(
+                    self, target, input or {}, None,
+                    constraints=constraints,
+                )
             else:
                 results = RegoDriver._audit(self, target, None)
         return Response(target=target, results=results)
+
+    # -- partitioned dispatch (docs/robustness.md §Fault domains) ------------
+
+    def query_many_subset(
+        self, path: str, inputs: Sequence[Any], subset, device: int = 0
+    ) -> List[Response]:
+        """Partition-scoped fused dispatch: evaluate ONLY `subset`'s
+        constraints for every input, as one device execution attributed
+        to logical `device`. The device-labeled fault point
+        (`driver.device_dispatch[device=N]`) gates the whole partition
+        dispatch, so the chaos suite can sicken exactly one fault
+        domain. Small batches keep the adaptive interpreter route (same
+        policy as `query_many`; results identical by the parity
+        contract). Merged across a plan's partitions, results are
+        bit-identical to the monolithic dispatch
+        (`parallel.partition.merge_partition_results` + the partition
+        parity battery)."""
+        m = _HOOK_RE.match(path)
+        if m is None or m.group(2) != "violation":
+            raise ValueError(f"unsupported partition query path: {path!r}")
+        target = m.group(1)
+        fire(device_point("driver.device_dispatch", device))
+        with self._mutex:
+            cs = self._subset_cset(target, frozenset(subset))
+            if cs is None:
+                return [
+                    Response(target=target, results=[]) for _ in inputs
+                ]
+            if self.use_jax and len(inputs) < MIN_DEVICE_BATCH:
+                # adaptive routing, same floor as query_many: a tiny
+                # batch finishes faster on the serial interpreter than
+                # a device round trip would take
+                return [
+                    Response(
+                        target=target,
+                        results=RegoDriver._violation(
+                            self, target, i or {}, None,
+                            constraints=cs.constraints,
+                        ),
+                    )
+                    for i in inputs
+                ]
+            handler = self._handler(target)
+            ns_cache = self._ns_cache(target)
+            reviews = [
+                H.hook_get_default(i or {}, "review", {}) for i in inputs
+            ]
+            rej_constraints = [
+                c for c in cs.constraints
+                if handler.constraint_needs_context(c)
+            ]
+            autorejects: List[List[Result]] = []
+            for review in reviews:
+                out: List[Result] = []
+                if rej_constraints and handler.review_autorejects(
+                    review, ns_cache
+                ):
+                    out = [
+                        _autoreject_result(c, review)
+                        for c in rej_constraints
+                    ]
+                autorejects.append(out)
+            split = self._eval_reviews_split(
+                target, reviews, None, None, cset=cs
+            )
+        return [
+            Response(target=target, results=auto + ev)
+            for auto, ev in zip(autorejects, split)
+        ]
+
+    def prepare_subset(self, path: str, subset, device: int = 0) -> bool:
+        """Stage one partition's sub-program onto its device: build the
+        subset constraint set and upload its policy tensors. This is
+        the restage step of quarantine re-homing — the device-labeled
+        fault point (`driver.restage[device=N]`) makes restage failure
+        injectable, and the quarantine manager retries with backoff
+        while the subset serves from the host rung."""
+        m = _HOOK_RE.match(path)
+        if m is None or m.group(2) != "violation":
+            raise ValueError(f"unsupported partition query path: {path!r}")
+        target = m.group(1)
+        fire(device_point("driver.restage", device))
+        with self._mutex:
+            cs = self._subset_cset(target, frozenset(subset))
+            if cs is None:
+                return True
+            if self.use_jax and self.kernel is not None and cs.policy is None:
+                cs.policy = self.kernel.stage_policy(cs.programs, cs.ms)
+        return True
 
     # -- serve-while-compiling (cold-start) ----------------------------------
 
@@ -1448,18 +1622,21 @@ class TpuDriver(RegoDriver):
         trace: Optional[List[str]],
         corpus: Optional[_Corpus],
         require_compiled: bool = False,
+        cset: Optional[_ConstraintSet] = None,
     ) -> List[List[Result]]:
         """Shared compiled-path evaluation: match x programs on device,
         interpreter rendering of the sparse violating pairs; results
         grouped per review (review-major order preserved).
         require_compiled propagates to the kernel dispatch: ColdKernel
         escapes (before any result is produced) when this batch's shape
-        bucket has no compiled entry yet."""
+        bucket has no compiled entry yet. `cset` overrides the target's
+        full constraint set with a partition-scoped one
+        (query_many_subset)."""
         import time as _time
 
         t_start = _time.perf_counter()
         with self._mutex:
-            cs = self._constraint_set(target)
+            cs = cset if cset is not None else self._constraint_set(target)
             if cs is None:
                 self.stats = {}
                 return [[] for _ in reviews]

@@ -98,6 +98,12 @@ class Runner:
         # mirrors webhook.server.DEFAULT_MAX_QUEUE)
         fail_policy: str = "open",
         max_queue=2048,
+        # device fault domains (docs/robustness.md §Fault domains):
+        # split the constraint corpus into this many partitions, each
+        # guarded by its own per-device breaker — a sick chip sheds its
+        # constraint subset instead of tripping the whole plane. 0 =
+        # monolithic dispatch (the pre-partition behavior).
+        partitions: int = 0,
         # fleet plane (docs/fleet.md): CR-backed gossip making the
         # external-data cache and breaker trips fleet properties.
         # True builds a FleetPlane keyed by pod_name; pass an existing
@@ -163,6 +169,7 @@ class Runner:
         self.readyz_port = readyz_port
         self.fail_policy = fail_policy
         self.max_queue = max_queue
+        self.partitions = int(partitions or 0)
         self.drain_grace_s = drain_grace_s
         self.exempt_namespaces = list(exempt_namespaces)
         self.webhook_tls = webhook_tls
@@ -467,8 +474,19 @@ class Runner:
                 fail_policy=self.fail_policy,
                 max_queue=self.max_queue,
                 drain_grace_s=self.drain_grace_s,
+                partitions=self.partitions or None,
             )
             self.webhook.start()
+            if (
+                self.fleet is not None
+                and self.webhook.partitioner is not None
+            ):
+                # per-device breaker state is a fleet property: each
+                # device breaker registers under its
+                # device:<plane>:<device_id> key as it is created, so a
+                # chip sick on one replica pre-opens the same device's
+                # breaker on peers (docs/fleet.md)
+                self.webhook.partitioner.set_fleet(self.fleet)
             if self.fleet is not None:
                 # device-breaker trips gossip: an outage one replica
                 # discovered pre-opens peers' breakers to a half-open
@@ -797,6 +815,15 @@ class Runner:
                         breaker = runner.webhook.batcher.breaker
                         if breaker is not None:
                             wh["breaker"] = breaker.snapshot()
+                        partitioner = getattr(
+                            runner.webhook, "partitioner", None
+                        )
+                        if partitioner is not None:
+                            # fault-domain health: the partition plan,
+                            # quarantine state, and per-device breaker
+                            # snapshots (docs/robustness.md §Fault
+                            # domains)
+                            wh["partitions"] = partitioner.snapshot()
                         mb = runner.webhook.mutate_batcher
                         if mb is not None:
                             wh["mutation"] = {

@@ -185,6 +185,7 @@ class ExternalDataSystem:
                     failure_threshold=self.breaker_threshold,
                     recovery_seconds=self.breaker_recovery_s,
                     plane="externaldata",
+                    name=f"provider:{p.name}",
                     metrics=(
                         _BreakerMetricsShim(self.metrics, p.name)
                         if self.metrics is not None

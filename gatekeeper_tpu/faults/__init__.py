@@ -19,6 +19,7 @@ from .injection import (
     FaultRegistry,
     FaultSpec,
     configure_from_env,
+    device_point,
     fire,
     skew,
 )
@@ -38,6 +39,7 @@ __all__ = [
     "OPEN",
     "ShedError",
     "configure_from_env",
+    "device_point",
     "fire",
     "skew",
 ]

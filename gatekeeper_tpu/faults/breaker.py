@@ -54,12 +54,31 @@ class CircuitBreaker:
         metrics=None,
         tracer=None,
         clock: Callable[[], float] = time.monotonic,
+        # device fault domains (docs/robustness.md §Fault domains): a
+        # per-device breaker carries its device id as a metric tag and
+        # in its name, so multi-breaker accounting (transition ledgers,
+        # fleet gossip keys, snapshots) stays exact per breaker instead
+        # of assuming one breaker per plane
+        device=None,
+        name: Optional[str] = None,
     ):
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
         self.failure_threshold = failure_threshold
         self.recovery_seconds = recovery_seconds
         self.plane = plane
+        self.device = None if device is None else str(device)
+        self.name = name or (
+            f"device:{plane}:{self.device}"
+            if self.device is not None
+            else f"device:{plane}"
+        )
+        # the tag set every metric emission carries; the device tag is
+        # only added when set, so single-breaker planes keep their
+        # pre-partitioning series shape
+        self._tags = {"plane": plane}
+        if self.device is not None:
+            self._tags["device"] = self.device
         self.metrics = metrics
         self.tracer = tracer
         self._clock = clock
@@ -86,10 +105,13 @@ class CircuitBreaker:
             return self._state
 
     def snapshot(self) -> dict:
-        """Readyz/debug view of the breaker."""
+        """Readyz/debug view of the breaker, keyed by its name so
+        multi-breaker planes (one per device) snapshot unambiguously."""
         with self._lock:
             self._maybe_half_open_locked()
             return {
+                "name": self.name,
+                "device": self.device,
                 "state": self._state,
                 "consecutive_failures": self._consecutive_failures,
                 "transitions": self.transitions,
@@ -128,14 +150,14 @@ class CircuitBreaker:
                 pass  # gossip is best-effort; the breaker must not die
         if self.metrics is not None:
             self.metrics.record(
-                "device_breaker_transitions_total", 1, plane=self.plane,
+                "device_breaker_transitions_total", 1, **self._tags,
                 from_state=from_state, to_state=to_state,
             )
         if self.tracer is not None:
             # a standalone one-span trace: transitions are rare and must
             # be findable in /debug/traces without a request to ride on
             with self.tracer.start_span(
-                "breaker_transition", plane=self.plane,
+                "breaker_transition", breaker=self.name, **self._tags,
                 from_state=from_state, to_state=to_state,
             ):
                 pass
@@ -144,7 +166,7 @@ class CircuitBreaker:
         if self.metrics is not None:
             self.metrics.gauge(
                 "device_breaker_state", _STATE_VALUE[self._state],
-                plane=self.plane,
+                **self._tags,
             )
 
     # -- fleet gossip ---------------------------------------------------------
@@ -207,7 +229,7 @@ class CircuitBreaker:
                 if self.metrics is not None:
                     self.metrics.record(
                         "device_breaker_probes_total", 1,
-                        plane=self.plane, result="success",
+                        **self._tags, result="success",
                     )
                 self._transition_locked(CLOSED)
             else:
@@ -219,7 +241,7 @@ class CircuitBreaker:
                 if self.metrics is not None:
                     self.metrics.record(
                         "device_breaker_probes_total", 1,
-                        plane=self.plane, result="failure",
+                        **self._tags, result="failure",
                     )
                 self._transition_locked(OPEN)
                 return

@@ -34,12 +34,30 @@ The fault-point catalog lives in docs/robustness.md.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 MODES = ("error", "hang", "clock_jump")
+
+# point=mode[:modifiers]; the point may itself contain '=' inside a
+# device label ("driver.device_dispatch[device=1]=error"), so the split
+# anchors on the first '=' that is followed by a KNOWN mode, not on the
+# first '=' in the entry
+_ENTRY_RE = re.compile(
+    r"^(?P<point>.+?)=(?P<mode>" + "|".join(MODES) + r")(?::(?P<rest>.*))?$"
+)
+
+
+def device_point(point: str, device) -> str:
+    """Device-labeled fault point name (docs/robustness.md §Fault
+    domains): `device_point("driver.device_dispatch", 1)` ->
+    `"driver.device_dispatch[device=1]"`. The label is part of the
+    point NAME, so arm/hit/fire accounting — and the env-string grammar
+    — stays exact per device with zero new registry machinery."""
+    return f"{point}[device={device}]"
 
 
 class FaultError(RuntimeError):
@@ -229,14 +247,14 @@ def configure_from_env(registry: Optional[FaultRegistry] = None,
         entry = entry.strip()
         if not entry or "=" not in entry:
             continue
-        point, _, rest = entry.partition("=")
-        parts = rest.split(":")
-        mode = parts[0].strip()
-        if mode not in MODES:
+        m = _ENTRY_RE.match(entry)
+        if m is None:
             continue
+        point, mode = m.group("point"), m.group("mode")
+        rest = m.group("rest") or ""
         kwargs = {}
         ok = True
-        for part in parts[1:]:
+        for part in rest.split(":") if rest else ():
             key, _, val = part.partition("=")
             try:
                 if key == "count":

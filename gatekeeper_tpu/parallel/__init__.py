@@ -8,4 +8,10 @@ constraint-axis shard for very large constraint populations. See
 `sharding.FusedAuditKernel`.
 """
 
+from .partition import (  # noqa: F401
+    PartitionDispatcher,
+    PartitionPlan,
+    build_plan,
+    merge_partition_results,
+)
 from .sharding import FusedAuditKernel, audit_mesh  # noqa: F401

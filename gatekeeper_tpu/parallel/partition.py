@@ -1,0 +1,531 @@
+"""Device fault domains: partitioned program dispatch.
+
+One monolithic fused program made every device fault a whole-plane
+event: the single per-plane breaker tripped and EVERY request degraded
+to the host interpreter (docs/robustness.md §Fault domains). This
+module splits the staged constraint corpus into K independently
+compilable/dispatchable sub-programs — partitions — each homed on a
+logical device and guarded by its own per-(device, plane)
+`CircuitBreaker`, so one sick chip sheds exactly its constraint subset
+and nothing else:
+
+  * `PartitionPlan` — a deterministic split of the constraint corpus
+    (the driver's sorted `<kind>/<name>` identities, round-robin over K
+    partitions) with a device assignment per partition. The plan
+    rebuilds on constraint churn and on device-health changes, and
+    `to_dict()` is surfaced in `/readyz` and the partition metrics.
+  * `PartitionDispatcher` — the quarantine manager: lazily creates the
+    per-device breakers, re-homes a quarantined device's partitions
+    onto healthy devices (restage with exponential backoff through the
+    `driver.restage[device=N]` fault point), runs half-open probes
+    against quarantined devices on the breaker's own recovery
+    schedule, and degrades to the existing whole-plane host mode only
+    when every device is dead.
+  * `merge_partition_results` — the parity-preserving merge: combined
+    per-partition verdicts are bit-identical to the monolithic dispatch
+    (autorejects first, then evaluation results, both in the global
+    constraint order; pinned by the partition parity battery in
+    tests/test_partition.py).
+
+Devices here are *logical* fault domains (ids into the plan's device
+slots). On provisioned multi-chip hardware (ROADMAP item 3) the slots
+map to real chips; on a single-device host they still buy deterministic
+fault isolation because every device-attributed code path — dispatch,
+restage, probe — flows through the device-labeled fault points in
+`faults/injection.py`. The partition boundary this creates is the same
+one per-batch constraint pruning (ROADMAP item 1) dispatches over.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..faults import CLOSED, CircuitBreaker
+
+__all__ = [
+    "Partition",
+    "PartitionPlan",
+    "PartitionDispatcher",
+    "build_plan",
+    "merge_partition_results",
+]
+
+
+@dataclass(frozen=True)
+class Partition:
+    """One fault domain's constraint subset + device placement."""
+
+    index: int
+    home_device: int  # the deterministic assignment
+    device: int  # where it actually runs (≠ home while re-homed)
+    keys: Tuple[str, ...]  # constraint identities, global-sorted
+    subset: frozenset  # frozenset(keys) — the driver-facing form
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "index": self.index,
+            "home_device": self.home_device,
+            "device": self.device,
+            "constraints": len(self.keys),
+        }
+
+
+@dataclass
+class PartitionPlan:
+    """A deterministic constraint-corpus split with device placement."""
+
+    generation: int
+    constraint_gen: Any
+    partitions: List[Partition]
+    # constraint key -> global index: the merge order (the driver's
+    # sorted (kind, name) iteration order — exactly what the monolith
+    # emits in)
+    order: Dict[str, int]
+    devices: Tuple[int, ...]
+    all_dead: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "generation": self.generation,
+            "constraints": len(self.order),
+            "devices": list(self.devices),
+            "all_dead": self.all_dead,
+            "partitions": [p.to_dict() for p in self.partitions],
+        }
+
+
+def build_plan(
+    keys: Sequence[str],
+    k: int,
+    devices: Sequence[int],
+    healthy: frozenset,
+    constraint_gen: Any = None,
+    generation: int = 0,
+) -> PartitionPlan:
+    """Deterministic plan: partition p takes every k-th key of the
+    sorted identity list (`keys[p::k]` — balanced within one constraint
+    and rebalanced by construction on churn) and homes on
+    `devices[p % len(devices)]`. A partition whose home device is not
+    healthy re-homes onto the healthy device chosen round-robin by
+    partition index — same inputs, same plan, always."""
+    keys = list(keys)
+    order = {key: i for i, key in enumerate(keys)}
+    k_eff = min(max(1, int(k)), len(keys)) if keys else 0
+    healthy_list = sorted(d for d in devices if d in healthy)
+    partitions: List[Partition] = []
+    for p in range(k_eff):
+        pkeys = tuple(keys[p::k_eff])
+        home = devices[p % len(devices)]
+        if home in healthy:
+            device = home
+        elif healthy_list:
+            device = healthy_list[p % len(healthy_list)]
+        else:
+            device = home  # all dead: flagged below, never dispatched
+        partitions.append(
+            Partition(
+                index=p,
+                home_device=home,
+                device=device,
+                keys=pkeys,
+                subset=frozenset(pkeys),
+            )
+        )
+    return PartitionPlan(
+        generation=generation,
+        constraint_gen=constraint_gen,
+        partitions=partitions,
+        order=order,
+        devices=tuple(devices),
+        all_dead=not healthy_list,
+    )
+
+
+def merge_partition_results(
+    result_lists: Sequence[Sequence[Any]], order: Dict[str, int]
+) -> List[Any]:
+    """Merge one request's per-partition Result lists back into the
+    monolithic emit order: autoreject results first, then evaluation
+    results, each group in global constraint order; within one
+    (request, constraint) pair the partition's own result order is
+    preserved (stable sort). The partition parity battery pins
+    merged == monolith across constraint/partition counts."""
+    from ..constraint.driver import AUTOREJECT_MSG, constraint_key
+
+    merged = [r for results in result_lists for r in results]
+    fallback = len(order)
+
+    def sort_key(r):
+        c = getattr(r, "constraint", None) or {}
+        return (
+            0 if getattr(r, "msg", None) == AUTOREJECT_MSG else 1,
+            order.get(constraint_key(c), fallback),
+        )
+
+    merged.sort(key=sort_key)
+    return merged
+
+
+class PartitionDispatcher:
+    """Plan + per-device breakers + quarantine lifecycle for one
+    admission plane (the MicroBatcher's `partitioner`).
+
+    Thread-safety: the plan/breaker registry is lock-protected;
+    breaker transition listeners only write plain flags (never take
+    this lock — the breaker calls listeners under ITS lock, and plan
+    builds read breaker state under ours, so a listener acquiring our
+    lock would be an AB-BA deadlock). Device health is derived from
+    breaker state at plan-build time instead of being pushed from the
+    listener for exactly that reason.
+    """
+
+    def __init__(
+        self,
+        client,
+        target: str,
+        k: int,
+        devices: Optional[Sequence[int]] = None,
+        plane: str = "validation",
+        metrics=None,
+        tracer=None,
+        failure_threshold: int = 3,
+        recovery_seconds: float = 30.0,
+        restage_backoff_s: float = 0.5,
+        restage_backoff_max_s: float = 30.0,
+        clock=time.monotonic,
+        # called once per lazily created device breaker (the soak
+        # harness subscribes its transition ledger here)
+        breaker_listener=None,
+        probe_batch: int = 8,
+    ):
+        self.client = client
+        self.target = target
+        self.k = max(1, int(k))
+        if devices is None:
+            devices = range(self.k)
+        elif isinstance(devices, int):
+            devices = range(devices)
+        self.devices: Tuple[int, ...] = tuple(int(d) for d in devices)
+        if not self.devices:
+            raise ValueError("partition dispatch needs >= 1 device")
+        self.plane = plane
+        self.metrics = metrics
+        self.tracer = tracer
+        self.failure_threshold = failure_threshold
+        self.recovery_seconds = recovery_seconds
+        self.restage_backoff_s = restage_backoff_s
+        self.restage_backoff_max_s = restage_backoff_max_s
+        self.probe_batch = probe_batch
+        self._clock = clock
+        self._breaker_listener = breaker_listener
+        self._lock = threading.RLock()
+        self._breakers: Dict[int, CircuitBreaker] = {}
+        self._manual_quarantine: set = set()
+        self._plan: Optional[PartitionPlan] = None
+        self._plan_key: Any = None
+        self._plan_gen = 0
+        self._staged: set = set()  # (plan_gen, partition idx, device)
+        self._retry_at: Dict[int, float] = {}  # device -> next restage
+        self._backoff: Dict[int, float] = {}
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._closed = False
+        self.fleet = None
+        # accounting (snapshot/readyz/bench)
+        self.rehomes = 0
+        self.probes = 0
+        self.restage_failures = 0
+        self.dispatches: Dict[str, int] = {
+            "fused": 0, "host": 0, "failed": 0, "skipped": 0,
+        }
+
+    # -- breakers --------------------------------------------------------------
+
+    def breaker(self, device: int) -> CircuitBreaker:
+        """The per-(device, plane) breaker, created lazily — named
+        `device:<plane>:<device_id>`, the same key it registers under
+        in the fleet plane so a chip sick on one replica pre-opens the
+        SAME device's breaker on peers."""
+        created = None
+        with self._lock:
+            b = self._breakers.get(device)
+            if b is None:
+                b = created = CircuitBreaker(
+                    failure_threshold=self.failure_threshold,
+                    recovery_seconds=self.recovery_seconds,
+                    plane=self.plane,
+                    device=device,
+                    metrics=self.metrics,
+                    tracer=self.tracer,
+                    clock=self._clock,
+                )
+                self._breakers[device] = b
+        if created is not None:
+            if self._breaker_listener is not None:
+                try:
+                    self._breaker_listener(created)
+                except Exception:
+                    pass
+            if self.fleet is not None:
+                try:
+                    self.fleet.register_breaker(created.name, created)
+                except Exception:
+                    pass
+        return b
+
+    def set_fleet(self, fleet) -> None:
+        """Gossip per-device breaker state: register every breaker —
+        existing and future — under its `device:<plane>:<device_id>`
+        key (docs/fleet.md; the ROADMAP item 2 follow-up)."""
+        self.fleet = fleet
+        with self._lock:
+            existing = list(self._breakers.values())
+        for b in existing:
+            try:
+                fleet.register_breaker(b.name, b)
+            except Exception:
+                pass
+
+    def _device_healthy(self, device: int) -> bool:
+        if device in self._manual_quarantine:
+            return False
+        b = self._breakers.get(device)
+        # HALF_OPEN stays quarantined: the device rejoins the pool only
+        # after its probe (run_probes) actually closes the breaker
+        return b is None or b.state == CLOSED
+
+    def quarantine(self, device: int) -> None:
+        """Operator/scenario quarantine: take the device out of the
+        pool immediately (its partitions re-home on the next plan
+        build) without touching its breaker."""
+        with self._lock:
+            self._manual_quarantine.add(int(device))
+        self._export_quarantine()
+
+    def heal(self, device: int) -> None:
+        """Lift an operator quarantine (a breaker-driven quarantine
+        heals through its own probe cycle instead)."""
+        with self._lock:
+            self._manual_quarantine.discard(int(device))
+        self._export_quarantine()
+
+    def _export_quarantine(self) -> None:
+        if self.metrics is None:
+            return
+        for d in self.devices:
+            self.metrics.gauge(
+                "device_quarantine_state",
+                0 if self._device_healthy(d) else 1,
+                plane=self.plane, device=str(d),
+            )
+
+    # -- the plan --------------------------------------------------------------
+
+    def plan(self) -> Optional[PartitionPlan]:
+        """The current plan, rebuilt deterministically whenever the
+        constraint corpus churns or device health changes (quarantine
+        re-homes, heal restores homes). None when the driver has no
+        partitionable constraint corpus."""
+        driver = getattr(self.client, "_driver", None)
+        keys_fn = getattr(driver, "constraint_keys", None)
+        if keys_fn is None:
+            return None
+        gen_fn = getattr(driver, "constraint_generation", None)
+        gen = gen_fn() if gen_fn is not None else None
+        healthy = frozenset(
+            d for d in self.devices if self._device_healthy(d)
+        )
+        key = (gen, healthy, frozenset(self._manual_quarantine))
+        with self._lock:
+            if self._plan is not None and self._plan_key == key:
+                return self._plan
+        keys = keys_fn(self.target)
+        if not keys:
+            with self._lock:
+                self._plan, self._plan_key = None, key
+            return None
+        with self._lock:
+            self._plan_gen += 1
+            plan = build_plan(
+                keys, self.k, self.devices, healthy,
+                constraint_gen=gen, generation=self._plan_gen,
+            )
+            prev = self._plan
+            if prev is not None:
+                moved = sum(
+                    1
+                    for p, q in zip(plan.partitions, prev.partitions)
+                    if p.device != q.device
+                )
+                if moved:
+                    self.rehomes += moved
+                    if self.metrics is not None:
+                        self.metrics.record(
+                            "device_partition_rehomes_total", moved,
+                            plane=self.plane,
+                        )
+            self._plan, self._plan_key = plan, key
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "device_partition_count", len(plan.partitions),
+                plane=self.plane,
+            )
+        self._export_quarantine()
+        return plan
+
+    # -- restage (quarantine re-home) ------------------------------------------
+
+    def ensure_staged(self, part: Partition) -> bool:
+        """Stage `part`'s sub-program on its current device before the
+        first fused dispatch of a plan generation. A restage failure
+        (the `driver.restage[device=N]` fault point, or a real staging
+        error) backs off exponentially; the partition serves from the
+        host rung until a retry succeeds."""
+        now = self._clock()
+        with self._lock:
+            token = (self._plan_gen, part.index, part.device)
+            if token in self._staged:
+                return True
+            if now < self._retry_at.get(part.device, 0.0):
+                return False
+        prep = getattr(self.client, "prepare_subset", None)
+        try:
+            if prep is not None:
+                prep(part.subset, device=part.device)
+        except Exception:
+            with self._lock:
+                back = self._backoff.get(
+                    part.device, self.restage_backoff_s
+                )
+                self._retry_at[part.device] = now + back
+                self._backoff[part.device] = min(
+                    back * 2, self.restage_backoff_max_s
+                )
+                self.restage_failures += 1
+            if self.metrics is not None:
+                self.metrics.record(
+                    "device_partition_restage_failures_total", 1,
+                    plane=self.plane, device=str(part.device),
+                )
+            return False
+        with self._lock:
+            self._staged.add(token)
+            self._retry_at.pop(part.device, None)
+            self._backoff.pop(part.device, None)
+        return True
+
+    # -- probes ----------------------------------------------------------------
+
+    def run_probes(self, reviews: Sequence[Any]) -> None:
+        """Half-open probes against quarantined devices, on the
+        breaker's own recovery schedule (its `recovery_seconds` clock —
+        re-homed partitions carry no traffic to a quarantined device,
+        so without this nothing would ever close its breaker). The
+        probe re-dispatches the device's HOME partition subset against
+        a slice of the live batch; its results are discarded — the
+        batch was already answered — and only the breaker verdict
+        (CLOSED on success, re-OPEN on failure) matters."""
+        plan = self._plan
+        if plan is None or not reviews:
+            return
+        for device in self.devices:
+            with self._lock:
+                b = self._breakers.get(device)
+                manual = device in self._manual_quarantine
+            if b is None or manual:
+                continue
+            if b.state == CLOSED or not b.allow():
+                continue
+            part = next(
+                (p for p in plan.partitions if p.home_device == device),
+                None,
+            )
+            if part is None:
+                # no partition to probe with: count the probe slot as a
+                # success so an unused device never wedges half-open
+                b.record_success()
+                continue
+            self.probes += 1
+            try:
+                self.client.review_many_subset(
+                    list(reviews[: self.probe_batch]), part.subset,
+                    device=device,
+                )
+            except Exception:
+                b.record_failure()
+                self._note_probe(device, "failure")
+                continue
+            b.record_success()
+            self._note_probe(device, "success")
+
+    def _note_probe(self, device: int, result: str) -> None:
+        if self.metrics is not None:
+            self.metrics.record(
+                "device_quarantine_probes_total", 1,
+                plane=self.plane, device=str(device), result=result,
+            )
+
+    # -- dispatch accounting ---------------------------------------------------
+
+    def note_dispatch(self, route: str, device: Optional[int] = None) -> None:
+        with self._lock:
+            self.dispatches[route] = self.dispatches.get(route, 0) + 1
+        if self.metrics is not None:
+            self.metrics.record(
+                "device_partition_dispatch_total", 1,
+                plane=self.plane, route=route,
+                device="" if device is None else str(device),
+            )
+
+    @property
+    def executor(self) -> Optional[ThreadPoolExecutor]:
+        """Shared pool for concurrent partition dispatches (the driver
+        serializes its own critical sections; concurrency buys overlap
+        of encode/render work and, on real multi-device hardware,
+        device execution)."""
+        with self._lock:
+            if self._closed:
+                return None
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=min(8, self.k),
+                    thread_name_prefix=f"gk-part-{self.plane}",
+                )
+            return self._executor
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            ex, self._executor = self._executor, None
+        if ex is not None:
+            ex.shutdown(wait=False)
+
+    # -- introspection ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Readyz/debug view: the plan, quarantine state, per-device
+        breaker snapshots (keyed by breaker NAME), and dispatch/rehome/
+        probe accounting."""
+        with self._lock:
+            plan = self._plan
+            return {
+                "plane": self.plane,
+                "k": self.k,
+                "devices": list(self.devices),
+                "plan": plan.to_dict() if plan is not None else None,
+                "quarantined": sorted(
+                    d for d in self.devices if not self._device_healthy(d)
+                ),
+                "manual_quarantine": sorted(self._manual_quarantine),
+                "breakers": {
+                    b.name: b.snapshot()
+                    for b in self._breakers.values()
+                },
+                "dispatches": dict(self.dispatches),
+                "rehomes": self.rehomes,
+                "probes": self.probes,
+                "restage_failures": self.restage_failures,
+            }

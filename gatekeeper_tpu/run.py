@@ -81,6 +81,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fail-policy", default="open",
                    choices=["open", "closed"])
     p.add_argument("--max-queue", type=int, default=2048)
+    p.add_argument(
+        "--partitions", type=int, default=0,
+        help="split the constraint corpus into N device fault domains "
+        "(per-device breakers + quarantine; 0 = monolithic dispatch)",
+    )
     # graceful drain: seconds /readyz reports not-ready while the
     # webhook listener still accepts (SIGTERM flips readiness first,
     # the LB routes away, THEN the listener closes and in-flight
@@ -154,6 +159,7 @@ def build_runner(args, log=None, webhook_tls: bool = True):
         max_queue=(
             getattr(args, "max_queue", 2048) or None
         ),  # 0 -> unbounded
+        partitions=getattr(args, "partitions", 0),
         drain_grace_s=getattr(args, "drain_grace", 0.0),
         bind_addr="0.0.0.0",  # kubelet probes and the apiserver dial
         # the pod IP, not loopback

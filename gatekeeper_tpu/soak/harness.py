@@ -220,6 +220,7 @@ class _Replica:
         self.server = None
         self.fleet_plane = None
         self.rotator = None
+        self.partitioner = None  # device fault domains (partitions > 0)
 
     @property
     def base_url(self) -> str:
@@ -386,12 +387,36 @@ class SoakHarness:
         # a whole fault window waiting): share metrics/tracer so the
         # transition series and spans land in the same registries
         br = scn.breaker
+
+        def _ledger_subscribe(breaker, plane, replica):
+            # transition ledger keyed by breaker NAME: multi-breaker
+            # planes (one per device) stay exactly accounted instead of
+            # collapsing into one per-plane stream
+            breaker.subscribe(
+                lambda f, t, breaker=breaker, plane=plane, replica=replica: (
+                    self.transitions.append({
+                        "t_s": round(time.monotonic() - self._t0, 3),
+                        "replica": replica,
+                        "plane": plane,
+                        "breaker": breaker.name,
+                        "from": f,
+                        "to": t,
+                    })
+                )
+            )
+
         for batcher, plane in (
             (rep.server.batcher, "validation"),
             (rep.server.mutate_batcher, "mutation"),
             (rep.server.agent_batcher, "agent"),
         ):
             if batcher is None:
+                continue
+            if plane == "validation" and scn.partitions:
+                # device fault domains replace the single validation
+                # breaker: per-(device, plane) breakers live in the
+                # PartitionDispatcher (docs/robustness.md §Fault
+                # domains)
                 continue
             breaker = CircuitBreaker(
                 failure_threshold=int(br.get("failure_threshold", 3)),
@@ -401,21 +426,35 @@ class SoakHarness:
                 tracer=rep.tracer,
             )
             batcher.breaker = breaker
-            breaker.subscribe(
-                lambda f, t, plane=plane, replica=name: (
-                    self.transitions.append({
-                        "t_s": round(time.monotonic() - self._t0, 3),
-                        "replica": replica,
-                        "plane": plane,
-                        "from": f,
-                        "to": t,
-                    })
-                )
-            )
+            _ledger_subscribe(breaker, plane, name)
             if rep.fleet_plane is not None:
                 rep.fleet_plane.register_breaker(
                     f"device:{plane}", breaker
                 )
+        if scn.partitions:
+            from ..parallel.partition import PartitionDispatcher
+
+            disp = PartitionDispatcher(
+                rep.client,
+                K8S_TARGET,
+                k=scn.partitions,
+                plane="validation",
+                metrics=rep.metrics,
+                tracer=rep.tracer,
+                failure_threshold=int(br.get("failure_threshold", 3)),
+                recovery_seconds=float(br.get("recovery_seconds", 5.0)),
+                breaker_listener=lambda b, replica=name: (
+                    _ledger_subscribe(b, "validation", replica)
+                ),
+            )
+            rep.partitioner = disp
+            rep.server.partitioner = disp  # server.stop() closes it
+            rep.server.batcher.partitioner = disp
+            rep.server.batcher.breaker = None
+            if rep.fleet_plane is not None:
+                # per-device breakers gossip under their
+                # device:validation:<id> keys as they are created
+                disp.set_fleet(rep.fleet_plane)
         if rep.fleet_plane is not None:
             rep.fleet_plane.start()
         # the LB model: readiness flip takes the replica out of
@@ -578,6 +617,16 @@ class SoakHarness:
                 f"rotated certs via {rep.name} -> generation "
                 f"{rot.cert_generation}"
             )
+        elif action == "quarantine_device":
+            dev = int(params.get("device", 1))
+            for rep in self.replicas:
+                if rep.partitioner is not None:
+                    rep.partitioner.quarantine(dev)
+        elif action == "heal_device":
+            dev = int(params.get("device", 1))
+            for rep in self.replicas:
+                if rep.partitioner is not None:
+                    rep.partitioner.heal(dev)
         elif action == "kill_replica":
             idx = int(params.get("replica", 0))
             rep = self.replicas[idx]

@@ -57,6 +57,11 @@ ACTIONS: Dict[str, tuple] = {
     "disarm_faults": (),         # reset the whole fault registry
     "rotate_certs": (),          # force a cert rotation (tls only)
     "kill_replica": (),          # replica (default 0): LB-out + drain
+    # device fault domains (needs partitions > 0): operator-style
+    # quarantine of one logical device (its partitions re-home onto
+    # healthy devices) and the matching heal
+    "quarantine_device": (),     # device (default 1)
+    "heal_device": (),           # device (default 1)
 }
 
 
@@ -116,6 +121,11 @@ class Scenario:
     # faults would never fire and the device-time split would be empty.
     # None keeps the deployment default.
     min_device_batch: Optional[int] = None
+    # device fault domains (docs/robustness.md §Fault domains): split
+    # each replica's validation plane into this many constraint-subset
+    # partitions with per-device breakers + quarantine; 0 keeps the
+    # monolithic dispatch + single plane breaker
+    partitions: int = 0
     planes: Dict[str, float] = field(
         default_factory=lambda: {
             "validation": 0.7, "mutation": 0.15, "agent": 0.15
@@ -160,6 +170,17 @@ class Scenario:
                         f"kill_replica index {idx} out of range for "
                         f"{self.replicas} replicas"
                     )
+            if ev.action in ("quarantine_device", "heal_device"):
+                if self.partitions < 1:
+                    raise ValueError(
+                        f"{ev.action} requires partitions >= 1"
+                    )
+                dev = int(ev.params.get("device", 1))
+                if not (0 <= dev < self.partitions):
+                    raise ValueError(
+                        f"{ev.action} device {dev} out of range for "
+                        f"{self.partitions} partitions"
+                    )
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "Scenario":
@@ -167,7 +188,7 @@ class Scenario:
             "name", "duration_s", "rps", "deadline_s", "window_s",
             "seed", "replicas", "tls", "constraints", "external_keys",
             "violating_fraction", "window_ms", "min_device_batch",
-            "planes", "breaker", "capacity", "events",
+            "partitions", "planes", "breaker", "capacity", "events",
         }
         unknown = set(d) - known
         if unknown:
@@ -195,6 +216,7 @@ class Scenario:
             "violating_fraction": self.violating_fraction,
             "window_ms": self.window_ms,
             "min_device_batch": self.min_device_batch,
+            "partitions": self.partitions,
             "planes": dict(self.planes),
             "breaker": dict(self.breaker),
             "capacity": self.capacity,
@@ -253,8 +275,11 @@ def default_scenario() -> Scenario:
     steady open-loop load for the leak curves, then churn
     (constraints + template + provider + mutator adds), a fault window
     (device faults trip the breaker while the host rung stalls — the
-    SLO must degrade and then recover post-disarm), a live cert
-    rotation, and a graceful replica kill that replica B absorbs."""
+    SLO must degrade and then recover post-disarm), a sick-chip window
+    (ONE device of the 4-partition plan faulted: only its constraint
+    subset degrades, then the operator quarantine/heal path re-homes
+    it), a live cert rotation, and a graceful replica kill that
+    replica B absorbs."""
     return Scenario.from_dict({
         "name": "soak-default",
         "duration_s": 150.0,
@@ -271,6 +296,9 @@ def default_scenario() -> Scenario:
         # device faults actually fire; see Scenario.min_device_batch)
         "window_ms": 10.0,
         "min_device_batch": 2,
+        # device fault domains: 4 constraint-subset partitions, each
+        # with its own per-device breaker (§Fault domains)
+        "partitions": 4,
         "breaker": {"failure_threshold": 3, "recovery_seconds": 5.0},
         "capacity": {
             "constraint_counts": [10, 100],
@@ -292,8 +320,19 @@ def default_scenario() -> Scenario:
              "delay": 0.35},
             {"at": 100.0, "action": "disarm_faults"},
             # recovery judged after the hang-built backlog drains
-            {"at": 105.0, "action": "phase", "name": "recovery"},
-            {"at": 115.0, "action": "rotate_certs"},
+            {"at": 103.0, "action": "phase", "name": "recovery"},
+            # sick chip: ONE device faulted — its partition's subset
+            # degrades to host (blast radius = 1/partitions), the
+            # breaker trips it into quarantine, and after the disarm
+            # the operator quarantine/heal path exercises re-homing
+            {"at": 108.0, "action": "phase", "name": "sick_chip"},
+            {"at": 108.5, "action": "arm_fault",
+             "point": "driver.device_dispatch[device=1]",
+             "mode": "error"},
+            {"at": 114.0, "action": "disarm_faults"},
+            {"at": 114.5, "action": "quarantine_device", "device": 1},
+            {"at": 117.0, "action": "heal_device", "device": 1},
+            {"at": 118.0, "action": "rotate_certs"},
             {"at": 120.0, "action": "phase", "name": "kill"},
             {"at": 121.0, "action": "kill_replica", "replica": 0},
         ],

@@ -102,9 +102,20 @@ class MicroBatcher:
         # device circuit breaker: None = construct the default; False =
         # disabled; or pass a faults.CircuitBreaker to share/observe
         breaker=None,
+        # device fault domains (docs/robustness.md §Fault domains): a
+        # parallel.partition.PartitionDispatcher replaces the single
+        # per-plane breaker with per-(device, plane) breakers — batches
+        # fan out over constraint-subset partitions, a failed partition
+        # degrades ONLY its subset to the host rung, and quarantined
+        # devices re-home their partitions onto healthy ones
+        partitioner=None,
     ):
         self.client = client
         self.target = target
+        self.partitioner = partitioner
+        if partitioner is not None and breaker is None:
+            # the per-device breaker bank replaces the plane breaker
+            breaker = False
         # the target handler owns serving-plane review construction
         # (K8s: AdmissionRequest -> AugmentedReview; agent: tool-call
         # record -> AgentAction); client=None planes (MutateBatcher)
@@ -303,6 +314,15 @@ class MicroBatcher:
             )
             for request, _, _, _, _ in batch
         ]
+        if self.partitioner is not None:
+            plan = None
+            try:
+                plan = self.partitioner.plan()
+            except Exception:
+                plan = None  # plan failure: monolithic path still serves
+            if plan is not None and plan.partitions:
+                self._dispatch_partitioned(batch, reviews, plan, wall0, t0)
+                return
         breaker = self.breaker
         if breaker is not None and not breaker.allow():
             # breaker open: the fused path has been failing — go
@@ -340,6 +360,174 @@ class MicroBatcher:
         for (_, fut, _, _, _), responses in zip(batch, all_responses):
             resp = responses.by_target.get(self.target)
             fut.set_result(resp.results if resp is not None else [])
+
+    def _dispatch_partitioned(self, batch, reviews, plan,
+                              wall0: float, t0: float) -> None:
+        """Fault-domain dispatch (docs/robustness.md §Fault domains):
+        fan the batch out over the plan's constraint-subset partitions,
+        each gated by its device's breaker. A failed/open partition
+        degrades ONLY its constraint subset — and only the requests
+        that subset matches — to the host-interpreter rung; healthy
+        partitions stay fused. Merged results are bit-identical to the
+        monolithic dispatch (the partition parity battery pins it)."""
+        from ..parallel.partition import merge_partition_results
+
+        part = self.partitioner
+        client = self.client
+        if plan.all_dead:
+            # the whole device fleet is quarantined: fall back to the
+            # existing whole-plane host mode — and still run probes, or
+            # nothing would ever bring a device back
+            if self.metrics is not None:
+                self.metrics.record(
+                    "webhook_degraded_dispatch_total", 1, plane=self.plane
+                )
+            self._dispatch_host(batch, reviews, wall0, t0, route="degraded")
+            part.run_probes(reviews)
+            return
+        try:
+            fire("webhook.batch_dispatch")
+        except Exception:
+            # a whole-plane fault (the unlabeled point): every device
+            # pays a failure — this is the pre-partition behavior and
+            # keeps existing chaos scenarios meaningful
+            for p in plan.partitions:
+                part.breaker(p.device).record_failure()
+            self.batch_failures += 1
+            if self.metrics is not None:
+                self.metrics.record("webhook_batch_failures_total", 1)
+            self._dispatch_host(batch, reviews, wall0, t0, route="fallback")
+            part.run_probes(reviews)
+            return
+        prefetch = getattr(client, "prefetch_external", None)
+        if prefetch is not None:
+            # one deduped external-data fetch epoch for the whole batch
+            # (every partition dispatch then serves from the cache)
+            try:
+                prefetch(reviews)
+            except Exception:
+                pass
+        try:
+            masks = client.partition_match_mask(
+                reviews, [p.subset for p in plan.partitions]
+            )
+        except Exception:
+            # sound fallback: every partition sees every request
+            masks = [[True] * len(reviews) for _ in plan.partitions]
+        fused: List[Any] = []
+        host_parts: List[Any] = []
+        for p, mask in zip(plan.partitions, masks):
+            if not any(mask):
+                # nothing in this batch touches the partition: zero
+                # cost, zero degraded dispatches — the blast-radius
+                # contract for requests matching only healthy subsets
+                part.note_dispatch("skipped", p.device)
+                continue
+            br = part.breaker(p.device)
+            if not br.allow():
+                if self.metrics is not None:
+                    self.metrics.record(
+                        "webhook_degraded_dispatch_total", 1,
+                        plane=self.plane,
+                    )
+                host_parts.append(p)
+            elif not part.ensure_staged(p):
+                # restage (re-home) not complete: host rung until the
+                # backoff-gated retry lands
+                host_parts.append(p)
+            else:
+                fused.append((p, br))
+
+        def run_one(p, br):
+            try:
+                return p, br, client.review_many_subset(
+                    reviews, p.subset, device=p.device
+                ), None
+            except Exception as e:
+                return p, br, None, e
+
+        executor = part.executor if len(fused) > 1 else None
+        if executor is not None:
+            outcomes = list(executor.map(lambda a: run_one(*a), fused))
+        else:
+            outcomes = [run_one(p, br) for p, br in fused]
+        # partition index -> per-request result lists
+        part_results: Dict[int, List[List[Any]]] = {}
+        for p, br, resps, exc in outcomes:
+            if exc is None:
+                br.record_success()
+                part.note_dispatch("fused", p.device)
+                rows: List[List[Any]] = []
+                for responses in resps:
+                    resp = responses.by_target.get(self.target)
+                    rows.append(resp.results if resp is not None else [])
+                part_results[p.index] = rows
+            else:
+                br.record_failure()
+                self.batch_failures += 1
+                if self.metrics is not None:
+                    self.metrics.record("webhook_batch_failures_total", 1)
+                part.note_dispatch("failed", p.device)
+                host_parts.append(p)
+        # host rung, scoped: only the degraded partitions' subsets, and
+        # only the requests those subsets match
+        errors: Dict[int, Exception] = {}
+        degraded_reqs: Dict[int, List[int]] = {}
+        for p in host_parts:
+            try:
+                fire("webhook.host_review")
+            except FaultError as e:
+                for i, hit in enumerate(masks[p.index]):
+                    if hit:
+                        errors.setdefault(i, EvaluationUnavailable(str(e)))
+                part.note_dispatch("host", p.device)
+                continue
+            rows = [[] for _ in reviews]
+            for i, review in enumerate(reviews):
+                if not masks[p.index][i]:
+                    continue
+                degraded_reqs.setdefault(i, []).append(p.index)
+                try:
+                    responses = client.review_host(review, subset=p.subset)
+                    resp = responses.by_target.get(self.target)
+                    rows[i] = resp.results if resp is not None else []
+                except Exception as e:
+                    errors[i] = e
+            part_results[p.index] = rows
+            part.note_dispatch("host", p.device)
+        self.batches_dispatched += 1
+        self.requests_batched += len(batch)
+        if self.metrics is not None:
+            self.metrics.record("webhook_batches_total", 1)
+            self.metrics.observe("webhook_batch_size", len(batch))
+        self._record_spans(
+            batch, wall0, t0,
+            route="batched" if not host_parts else "partitioned",
+        )
+        if self.tracer is not None and degraded_reqs:
+            # per-REQUEST degraded accounting: only requests whose
+            # verdict was (partly) served from the host rung carry the
+            # span — requests matching only healthy partitions show a
+            # pure fused trace (the chaos e2e pins this)
+            wall1 = wall0 + (time.perf_counter() - t0)
+            for i, pidx in degraded_reqs.items():
+                ctx = batch[i][2]
+                if ctx is not None:
+                    self.tracer.record_span(
+                        "degraded_subset", wall0, wall1, parent=ctx,
+                        plane=self.plane, partitions=sorted(pidx),
+                    )
+        for i, (_, fut, _, _, _) in enumerate(batch):
+            if i in errors:
+                fut.set_exception(errors[i])
+            else:
+                fut.set_result(
+                    merge_partition_results(
+                        [rows[i] for rows in part_results.values()],
+                        plan.order,
+                    )
+                )
+        part.run_probes(reviews)
 
     def _dispatch_host(self, batch, reviews, wall0: float, t0: float,
                        route: str) -> None:
@@ -522,11 +710,31 @@ class WebhookServer:
         # watching /readyz routes away before connections start failing
         # (the preStop-sleep pattern; 0 = flip-and-close immediately)
         drain_grace_s: float = 0.0,
+        # device fault domains (docs/robustness.md §Fault domains):
+        # split the constraint corpus into this many partitions, each
+        # on its own logical device with its own breaker — a sick
+        # device sheds only its constraint subset, not the plane.
+        # 0/None keeps the monolithic dispatch + single plane breaker.
+        partitions: Optional[int] = None,
+        partition_devices: Optional[int] = None,
     ):
         self.client = client  # warmup() compiles through it
         self.tracer = tracer
         self.request_timeout = request_timeout
         self.drain_grace_s = drain_grace_s
+        self.partitioner = None
+        if partitions:
+            from ..parallel.partition import PartitionDispatcher
+
+            self.partitioner = PartitionDispatcher(
+                client,
+                target,
+                k=partitions,
+                devices=partition_devices,
+                plane="validation",
+                metrics=metrics,
+                tracer=tracer,
+            )
         # graceful-drain state: `draining` flips BEFORE the listener
         # closes (readiness consults it), in-flight HTTP requests are
         # counted so stop() can wait for them, and on_drain callbacks
@@ -541,6 +749,7 @@ class WebhookServer:
             namespace_getter=namespace_getter,
             metrics=metrics, tracer=tracer,
             max_queue=max_queue,
+            partitioner=self.partitioner,
         )
         self.mutate_batcher = None
         self.mutation_handler = None
@@ -827,6 +1036,8 @@ class WebhookServer:
             self.agent_batcher.stop()
         if self.agent_mutate_batcher is not None:
             self.agent_mutate_batcher.stop()
+        if self.partitioner is not None:
+            self.partitioner.close()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -298,6 +298,78 @@ def test_breaker_full_second_cycle_accounting():
     assert not snap["probe_in_flight"]
 
 
+def test_two_concurrent_device_breakers_exact_accounting():
+    """The PR 8 second-cycle accounting contract, extended to TWO
+    concurrent device breakers on one plane (the fault-domain shape):
+    transition ledgers key by breaker NAME, metric series key by the
+    device tag, and one breaker's full trip/probe cycle leaves the
+    other's ledger untouched — multi-breaker accounting stays exact."""
+    metrics = MetricsRegistry()
+    clock = [0.0]
+    b0 = CircuitBreaker(
+        failure_threshold=3, recovery_seconds=10.0, metrics=metrics,
+        clock=lambda: clock[0], device=0,
+    )
+    b1 = CircuitBreaker(
+        failure_threshold=3, recovery_seconds=10.0, metrics=metrics,
+        clock=lambda: clock[0], device=1,
+    )
+    assert b0.name == "device:validation:0"
+    assert b1.name == "device:validation:1"
+    ledger = {b0.name: [], b1.name: []}
+    for b in (b0, b1):
+        b.subscribe(
+            lambda f, t, name=b.name: ledger[name].append((f, t))
+        )
+    # device 1: full cycle + failed probe (the PR 8 sequence);
+    # device 0: a single interleaved trip-and-recover
+    for _ in range(3):
+        b1.record_failure()
+    b0.record_failure()
+    b0.record_failure()
+    b0.record_failure()
+    clock[0] = 10.5
+    assert b1.allow()  # device 1 half-open probe
+    b1.record_failure()  # probe fails: re-open, clock restarts
+    assert b0.allow()  # device 0's OWN probe slot (independent)
+    b0.record_success()
+    assert b0.state == CLOSED and b1.state == OPEN
+    clock[0] = 21.0
+    assert b1.allow()
+    b1.record_success()
+    assert b1.state == CLOSED
+    # exact per-name ledgers: no cross-contamination
+    assert ledger["device:validation:0"] == [
+        (CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED),
+    ]
+    assert ledger["device:validation:1"] == [
+        (CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, OPEN),
+        (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED),
+    ]
+    # metric series separate by device tag
+    assert counter(
+        metrics, "device_breaker_transitions_total",
+        plane="validation", device="0",
+        from_state="closed", to_state="open",
+    ) == 1
+    assert counter(
+        metrics, "device_breaker_transitions_total",
+        plane="validation", device="1",
+        from_state="half_open", to_state="open",
+    ) == 1
+    assert counter(
+        metrics, "device_breaker_probes_total",
+        plane="validation", device="1", result="failure",
+    ) == 1
+    assert counter(
+        metrics, "device_breaker_probes_total",
+        plane="validation", device="0", result="success",
+    ) == 1
+    # snapshots carry the name (readyz / soak ledger key)
+    assert b0.snapshot()["name"] == "device:validation:0"
+    assert b1.snapshot()["device"] == "1"
+
+
 def test_breaker_adopt_consistent_across_cycles():
     """Fleet adopt() across a full local cycle: adoptions count once
     per real transition, never re-fire on a no-op peer hint, and an
@@ -853,6 +925,217 @@ def test_audit_status_write_fault_counted_sweep_survives():
     FAULTS.reset()
     report = mgr.audit()
     assert mgr.sink.latest is report  # next sweep re-publishes
+
+
+# -- device fault domains (docs/robustness.md §Fault domains) ----------------
+
+
+PART_NAMESPACES = ["ns-a", "ns-b", "ns-c", "ns-d"]
+
+
+def build_partitioned_stack(recovery_clock, failure_threshold=2):
+    """4 constraint kinds, each matching exactly one namespace, split
+    over a 4-partition plan (sorted identities -> kind i lands in
+    partition i on device i): one namespace addresses one fault
+    domain."""
+    from gatekeeper_tpu.obs import Tracer
+    from gatekeeper_tpu.parallel.partition import PartitionDispatcher
+
+    cl = Backend(TpuDriver(use_jax=False)).new_client(K8sValidationTarget())
+    for i, ns in enumerate(PART_NAMESPACES):
+        kind = f"Fault{chr(65 + i)}"
+        cl.add_template({
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": kind.lower()},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": kind}}},
+                "targets": [{
+                    "target": TARGET,
+                    "rego": REQ_LABELS.replace("reqlabels", kind.lower()),
+                }],
+            },
+        })
+        cl.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind,
+            "metadata": {"name": f"need-owner-{ns}"},
+            "spec": {
+                "match": {"namespaces": [ns]},
+                "parameters": {"labels": ["owner"]},
+            },
+        })
+    metrics = MetricsRegistry()
+    tracer = Tracer()
+    disp = PartitionDispatcher(
+        cl, TARGET, k=4, metrics=metrics, tracer=tracer,
+        failure_threshold=failure_threshold, recovery_seconds=5.0,
+        clock=lambda: recovery_clock[0],
+    )
+    batcher = MicroBatcher(
+        cl, TARGET, window_ms=1.0, metrics=metrics, tracer=tracer,
+        partitioner=disp,
+    )
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=5.0, metrics=metrics, tracer=tracer,
+        fail_policy="open",
+    )
+    return cl, metrics, tracer, disp, batcher, handler
+
+
+def ns_request(i, ns, labels=None):
+    req = admission_request(i, labels=labels)
+    req["namespace"] = ns
+    req["object"]["metadata"]["namespace"] = ns
+    return req
+
+
+def test_partitioned_device_fault_isolates_constraint_subset():
+    """The fault-domain acceptance e2e: device 1 of 4 faulted via the
+    injection registry. Requests matching only healthy partitions stay
+    on the fused path (ZERO degraded dispatches, no degraded spans);
+    the faulted partition's subset degrades to host with CORRECT
+    verdicts; the breaker trip quarantines the device and re-homing
+    restores full fused coverage; post-disarm the half-open probe heals
+    the device and the plan returns to its home assignment. The SLO
+    holds throughout: every request gets a real verdict."""
+    from gatekeeper_tpu.faults import device_point
+
+    clock = [0.0]
+    _, metrics, tracer, disp, batcher, handler = build_partitioned_stack(
+        clock
+    )
+    deg = lambda: counter(  # noqa: E731
+        metrics, "webhook_degraded_dispatch_total", plane="validation"
+    )
+    batcher.start()
+    try:
+        # healthy: every namespace gets fused verdicts
+        for i, ns in enumerate(PART_NAMESPACES):
+            resp = handler.handle(ns_request(i, ns))
+            assert not resp.allowed and resp.code == 403
+            assert f"need-owner-{ns}" in resp.message
+        assert handler.handle(
+            ns_request(9, "ns-a", labels={"owner": "x"})
+        ).allowed
+        assert disp.dispatches["host"] == 0
+        assert disp.dispatches["failed"] == 0
+        assert deg() == 0
+
+        # device 1 sick: ns-b's subset degrades to host — with correct
+        # verdicts — while every other namespace stays fused
+        FAULTS.arm(device_point("driver.device_dispatch", 1),
+                   mode="error")
+        fused_before = disp.dispatches["fused"]
+        for i, ns in enumerate(["ns-a", "ns-c", "ns-d"]):
+            resp = handler.handle(ns_request(20 + i, ns))
+            assert not resp.allowed and resp.code == 403
+        # healthy-partition traffic paid zero degraded/host dispatches
+        assert disp.dispatches["host"] == 0
+        assert disp.dispatches["failed"] == 0
+        assert deg() == 0
+        assert disp.dispatches["fused"] == fused_before + 3
+        resp = handler.handle(ns_request(30, "ns-b"))  # failure 1
+        assert not resp.allowed and resp.code == 403  # host rung verdict
+        assert "need-owner-ns-b" in resp.message
+        assert disp.dispatches["failed"] == 1
+        assert disp.dispatches["host"] == 1
+        resp = handler.handle(ns_request(31, "ns-b"))  # failure 2: trip
+        assert not resp.allowed and resp.code == 403
+        assert disp.breaker(1).state == OPEN
+        snap = disp.snapshot()
+        assert snap["quarantined"] == [1]
+
+        # quarantined: partition 1 re-homes onto a healthy device and
+        # ns-b traffic is FUSED again while the chip is still sick
+        failed_before = disp.dispatches["failed"]
+        host_before = disp.dispatches["host"]
+        labeled_fire = FAULTS.fired(
+            device_point("driver.device_dispatch", 1)
+        )
+        resp = handler.handle(ns_request(32, "ns-b"))
+        assert not resp.allowed and resp.code == 403
+        assert disp.dispatches["failed"] == failed_before
+        assert disp.dispatches["host"] == host_before
+        plan = disp.plan()
+        rehomed = plan.partitions[1]
+        assert rehomed.home_device == 1 and rehomed.device != 1
+        assert disp.rehomes >= 1
+        # the sick device saw no further dispatches
+        assert FAULTS.fired(
+            device_point("driver.device_dispatch", 1)
+        ) == labeled_fire
+
+        # degraded spans: only ns-b requests carry one
+        degraded_ns = set()
+        for t in tracer.recent(200):
+            names = {s["name"] for s in t["spans"]}
+            if "degraded_subset" not in names:
+                continue
+            for s in t["spans"]:
+                if s["name"] == "handler":
+                    degraded_ns.add(s["attrs"].get("resource_namespace"))
+        assert degraded_ns == {"ns-b"}
+
+        # recovery: disarm, recovery window elapses, the probe heals
+        # the device, and the plan restores the home assignment
+        FAULTS.reset()
+        clock[0] = 6.0
+        resp = handler.handle(ns_request(40, "ns-b"))
+        assert not resp.allowed and resp.code == 403
+        # the probe runs on the batch worker AFTER the batch's futures
+        # resolve (off the request path): wait for it to land
+        deadline = time.monotonic() + 5.0
+        while (
+            disp.breaker(1).state != CLOSED
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert disp.breaker(1).state == CLOSED
+        assert disp.probes >= 1
+        plan = disp.plan()
+        assert all(p.device == p.home_device for p in plan.partitions)
+        assert counter(
+            metrics, "device_quarantine_probes_total",
+            plane="validation", device="1", result="success",
+        ) == 1
+    finally:
+        batcher.stop()
+        disp.close()
+
+
+def test_partitioned_all_devices_dead_falls_back_to_plane_host_mode():
+    """Every device breaker open: the partitioned path falls back to
+    the existing whole-plane host mode (correct verdicts, degraded
+    accounting) instead of wedging."""
+    clock = [0.0]
+    _, metrics, _, disp, batcher, handler = build_partitioned_stack(
+        clock, failure_threshold=1
+    )
+    FAULTS.arm("driver.device_dispatch", mode="error")  # every device
+    batcher.start()
+    try:
+        for i, ns in enumerate(PART_NAMESPACES):
+            resp = handler.handle(ns_request(i, ns))
+            assert not resp.allowed and resp.code == 403  # host verdicts
+        assert disp.plan().all_dead
+        FAULTS.reset()
+        resp = handler.handle(ns_request(50, "ns-a"))
+        assert not resp.allowed and resp.code == 403
+        assert counter(
+            metrics, "webhook_degraded_dispatch_total", plane="validation"
+        ) >= 1
+        # probes ran from the whole-plane host path and healed devices
+        clock[0] = 6.0
+        handler.handle(ns_request(51, "ns-b"))
+        deadline = time.monotonic() + 5.0
+        while disp.plan().all_dead and time.monotonic() < deadline:
+            time.sleep(0.01)
+            handler.handle(ns_request(52, "ns-c"))
+        assert not disp.plan().all_dead
+    finally:
+        batcher.stop()
+        disp.close()
 
 
 # -- webhook HTTP e2e under chaos --------------------------------------------

@@ -1,0 +1,476 @@
+"""Device fault domains: the partition plane's unit + parity suite
+(docs/robustness.md §Fault domains).
+
+What it pins:
+  * `PartitionPlan` determinism — same corpus, same plan; balanced
+    round-robin split; deterministic rebalance on constraint churn and
+    re-homing on quarantine (restored on heal; all-dead flagged);
+  * the **partition parity battery** — merged partitioned verdicts are
+    identical to the monolithic dispatch across constraint counts,
+    partition counts, and template mixes (VECTORIZED + PARTIAL_ROWS +
+    INTERPRETER verdicts, autorejecting constraints, and G_CAP-overflow
+    requests that route per-row to the interpreter);
+  * per-(device, plane) breakers — lazily created, named
+    `device:<plane>:<device_id>`, snapshotted by name, registered with
+    the fleet plane under the same key;
+  * restage backoff through the `driver.restage[device=N]` fault point.
+
+Runs in the chaos lane (`pytest -m chaos`) and tier-1 (numpy-mode
+TpuDriver: no jit compiles, deterministic).
+"""
+
+import pytest
+
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget, TpuDriver
+from gatekeeper_tpu.constraint.driver import constraint_key
+from gatekeeper_tpu.faults import CLOSED, FAULTS, device_point
+from gatekeeper_tpu.metrics import MetricsRegistry
+from gatekeeper_tpu.parallel.partition import (
+    PartitionDispatcher,
+    build_plan,
+    merge_partition_results,
+)
+
+pytestmark = pytest.mark.chaos
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+# VECTORIZED: the required-labels shape the compiler fully fuses
+V_REGO = """package partreq
+violation[{"msg": msg}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("missing: %v", [missing])
+}
+"""
+
+# INTERPRETER verdict (GK-V003): three nested array iterations
+I_REGO = """package partdeep
+violation[{"msg": msg}] {
+    leaf := input.review.object.spec.l1[_].l2[_].l3[_]
+    leaf == "x"
+    msg := "three nested array iterations"
+}
+"""
+
+# PARTIAL_ROWS verdict (GK-V001): json.marshal screen
+P_REGO = """package partblob
+violation[{"msg": msg}] {
+    raw := json.marshal(input.review.object.metadata.labels)
+    contains(raw, "forbidden")
+    msg := "label blob contains forbidden"
+}
+"""
+
+TEMPLATES = [
+    ("PartReq", V_REGO, {"labels": ["owner"]}),
+    ("PartDeep", I_REGO, None),
+    ("PartBlob", P_REGO, None),
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def build_battery_client(n_constraints):
+    """Mixed-verdict corpus: constraints cycle over the three template
+    kinds; every third PartReq constraint carries a namespaceSelector
+    (needs-context -> autoreject coverage on uncached namespaces)."""
+    cl = Backend(TpuDriver(use_jax=False)).new_client(K8sValidationTarget())
+    for kind, rego, _params in TEMPLATES:
+        cl.add_template({
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": kind.lower()},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": kind}}},
+                "targets": [{"target": TARGET, "rego": rego}],
+            },
+        })
+    for i in range(n_constraints):
+        kind, _rego, params = TEMPLATES[i % len(TEMPLATES)]
+        spec = {"match": {"kinds": [
+            {"apiGroups": [""], "kinds": ["Pod"]}
+        ]}}
+        if i % 3 == 0 and kind == "PartReq":
+            spec["match"]["namespaceSelector"] = {
+                "matchLabels": {"team": "core"}
+            }
+        if params:
+            spec["parameters"] = params
+        cl.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind,
+            "metadata": {"name": f"c{i:03d}"},
+            "spec": spec,
+        })
+    return cl
+
+
+def battery_request(i):
+    """Shape variety: labeled/unlabeled, deep l1/l2/l3 fanout hits for
+    PartDeep, forbidden label blobs for PartBlob, and a G_CAP-overflow
+    pod (70 containers) that routes per-row to the interpreter."""
+    labels = {}
+    if i % 3 == 1:
+        labels = {"owner": "a"}
+    if i % 4 == 2:
+        labels = {"blob": "forbidden-value"}
+    spec = {"containers": [{"name": "c", "image": "nginx"}]}
+    if i % 5 == 3:
+        spec["l1"] = [{"l2": [{"l3": ["x", "y"]}]}]
+    if i % 7 == 4:
+        spec = {"containers": [
+            {"name": f"c{j}", "image": "nginx"} for j in range(70)
+        ]}
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"p{i}",
+            "namespace": f"ns-{i % 3}",
+            **({"labels": labels} if labels else {}),
+        },
+        "spec": spec,
+    }
+    return {
+        "uid": f"u{i}",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": "CREATE",
+        "name": f"p{i}",
+        "namespace": obj["metadata"]["namespace"],
+        "userInfo": {"username": "alice"},
+        "object": obj,
+    }
+
+
+def augmented(cl, requests):
+    from gatekeeper_tpu.constraint.handler import handler_for
+
+    handler = handler_for(cl, TARGET)
+    return [handler.augment_request(r) for r in requests]
+
+
+def normalize(results):
+    return [
+        (
+            r.constraint.get("kind"),
+            (r.constraint.get("metadata") or {}).get("name"),
+            r.msg,
+        )
+        for r in results
+    ]
+
+
+# -- device-labeled fault points ----------------------------------------------
+
+
+def test_device_point_env_string_activation():
+    """`driver.device_dispatch[device=1]=error:count=5` must arm even
+    though the point name contains '=': the env grammar anchors on the
+    first '=' followed by a known mode, not the first '=' in the
+    entry."""
+    from gatekeeper_tpu.faults import FaultRegistry, configure_from_env
+
+    reg = FaultRegistry()
+    armed = configure_from_env(
+        reg,
+        env=(
+            "driver.device_dispatch[device=1]=error:count=5,"
+            "driver.restage[device=3]=hang:delay=0.25,"
+            "driver.device_dispatch=error"
+        ),
+    )
+    assert armed == 3
+    spec = reg.spec(device_point("driver.device_dispatch", 1))
+    assert spec is not None and spec.count == 5
+    spec = reg.spec(device_point("driver.restage", 3))
+    assert spec is not None and spec.mode == "hang"
+    assert spec.delay_s == 0.25
+    assert reg.spec("driver.device_dispatch").mode == "error"
+    # labeled points are independent of the unlabeled plane point
+    assert reg.spec(device_point("driver.device_dispatch", 2)) is None
+
+
+# -- the plan -----------------------------------------------------------------
+
+
+def test_plan_deterministic_and_balanced():
+    keys = [f"Kind/{chr(97 + i)}" for i in range(17)]
+    healthy = frozenset(range(4))
+    p1 = build_plan(keys, 4, range(4), healthy)
+    p2 = build_plan(keys, 4, range(4), healthy)
+    assert [p.keys for p in p1.partitions] == [p.keys for p in p2.partitions]
+    assert [p.device for p in p1.partitions] == [
+        p.device for p in p2.partitions
+    ]
+    sizes = [len(p.keys) for p in p1.partitions]
+    assert max(sizes) - min(sizes) <= 1  # balanced round-robin
+    # every key lands in exactly one partition
+    seen = [k for p in p1.partitions for k in p.keys]
+    assert sorted(seen) == sorted(keys)
+    # churn: a new key rebalances deterministically
+    p3 = build_plan(keys + ["Kind/zz"], 4, range(4), healthy)
+    assert sorted(
+        k for p in p3.partitions for k in p.keys
+    ) == sorted(keys + ["Kind/zz"])
+
+
+def test_plan_rehomes_on_quarantine_and_flags_all_dead():
+    keys = [f"K/{i}" for i in range(8)]
+    sick1 = build_plan(keys, 4, range(4), frozenset({0, 2, 3}))
+    assert not sick1.all_dead
+    for p in sick1.partitions:
+        if p.home_device == 1:
+            assert p.device in (0, 2, 3)  # re-homed
+        else:
+            assert p.device == p.home_device  # untouched
+    dead = build_plan(keys, 4, range(4), frozenset())
+    assert dead.all_dead
+    healed = build_plan(keys, 4, range(4), frozenset(range(4)))
+    assert all(p.device == p.home_device for p in healed.partitions)
+
+
+def test_plan_fewer_constraints_than_partitions():
+    plan = build_plan(["A/x"], 4, range(4), frozenset(range(4)))
+    assert len(plan.partitions) == 1
+    assert plan.partitions[0].keys == ("A/x",)
+    empty = build_plan([], 4, range(4), frozenset(range(4)))
+    assert empty.partitions == []
+
+
+# -- the parity battery -------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_constraints,k", [
+    (1, 1), (1, 4), (4, 2), (7, 3), (17, 4), (17, 7),
+])
+def test_partition_parity_battery(n_constraints, k):
+    """Merged partitioned verdicts == monolithic verdicts, request by
+    request — order included (autorejects first, then evaluation
+    results, in global constraint order) — across VECTORIZED /
+    PARTIAL_ROWS / INTERPRETER templates, needs-context constraints,
+    and overflow rows."""
+    cl = build_battery_client(n_constraints)
+    driver = cl._driver
+    keys = driver.constraint_keys(TARGET)
+    assert len(keys) == n_constraints
+    plan = build_plan(keys, k, range(k), frozenset(range(k)))
+    reviews = augmented(cl, [battery_request(i) for i in range(23)])
+    mono = cl.review_many(reviews)
+    per_part = [
+        cl.review_many_subset(reviews, p.subset, device=p.device)
+        for p in plan.partitions
+    ]
+    some_results = False
+    for i in range(len(reviews)):
+        merged = merge_partition_results(
+            [
+                (pp[i].by_target[TARGET].results
+                 if TARGET in pp[i].by_target else [])
+                for pp in per_part
+            ],
+            plan.order,
+        )
+        expect = (
+            mono[i].by_target[TARGET].results
+            if TARGET in mono[i].by_target else []
+        )
+        assert normalize(merged) == normalize(expect), f"request {i}"
+        some_results = some_results or bool(expect)
+    assert some_results  # the battery must not pass vacuously
+
+
+def test_partition_match_mask_scopes_subsets():
+    cl = build_battery_client(6)
+    driver = cl._driver
+    keys = driver.constraint_keys(TARGET)
+    reviews = augmented(cl, [battery_request(i) for i in range(6)])
+    # one subset per constraint: the mask for a PartDeep-only subset
+    # must clear requests with no deep structure and no autoreject path
+    masks = cl.partition_match_mask(
+        reviews, [frozenset([key]) for key in keys]
+    )
+    assert len(masks) == len(keys)
+    assert all(len(m) == len(reviews) for m in masks)
+    # whole-corpus subset: every request matches something (Pod kinds)
+    full = cl.partition_match_mask(reviews, [frozenset(keys)])
+    assert all(full[0])
+
+
+def test_host_subset_scoped_to_partition():
+    cl = build_battery_client(6)
+    keys = cl._driver.constraint_keys(TARGET)
+    reviews = augmented(cl, [battery_request(1)])  # labeled, no blob
+    full = cl.review_host(reviews[0])
+    sub = cl.review_host(reviews[0], subset=frozenset(keys[:2]))
+    full_keys = {
+        constraint_key(r.constraint)
+        for r in full.by_target[TARGET].results
+    }
+    sub_keys = {
+        constraint_key(r.constraint)
+        for r in sub.by_target[TARGET].results
+    }
+    assert sub_keys <= set(keys[:2])
+    assert sub_keys == {k for k in full_keys if k in set(keys[:2])}
+
+
+# -- the dispatcher -----------------------------------------------------------
+
+
+def test_dispatcher_breakers_named_per_device_and_fleet_registered():
+    cl = build_battery_client(4)
+    metrics = MetricsRegistry()
+    disp = PartitionDispatcher(
+        cl, TARGET, k=4, metrics=metrics, plane="validation"
+    )
+
+    class _Fleet:
+        def __init__(self):
+            self.registered = {}
+
+        def register_breaker(self, name, breaker):
+            self.registered[name] = breaker
+
+    fleet = _Fleet()
+    b1 = disp.breaker(1)
+    disp.set_fleet(fleet)  # existing breakers register
+    b3 = disp.breaker(3)  # future breakers register on creation
+    assert b1.name == "device:validation:1"
+    assert b3.name == "device:validation:3"
+    assert set(fleet.registered) == {
+        "device:validation:1", "device:validation:3",
+    }
+    snap = disp.snapshot()
+    assert set(snap["breakers"]) == {
+        "device:validation:1", "device:validation:3",
+    }
+    assert snap["breakers"]["device:validation:1"]["name"] == (
+        "device:validation:1"
+    )
+    # per-device gauge series exist side by side
+    gauges = metrics.snapshot()["gauges"]
+    assert gauges.get(
+        'device_breaker_state{device="1",plane="validation"}'
+    ) == 0
+    assert gauges.get(
+        'device_breaker_state{device="3",plane="validation"}'
+    ) == 0
+    disp.close()
+
+
+def test_device_breakers_gossip_across_fleet():
+    """Per-device breaker state is a fleet property: a chip sick on one
+    replica (its device:validation:<id> breaker OPEN) pre-opens the
+    SAME device's breaker on a peer replica to HALF_OPEN via FleetState
+    gossip — one probe instead of rediscovering the outage — while
+    every other device's breaker stays CLOSED."""
+    from gatekeeper_tpu.control.events import FakeCluster
+    from gatekeeper_tpu.faults import HALF_OPEN, OPEN
+    from gatekeeper_tpu.fleet import FleetPlane
+
+    cluster = FakeCluster()
+    cl_a = build_battery_client(4)
+    cl_b = build_battery_client(4)
+    plane_a = FleetPlane(cluster, "rep-a", publish_interval_s=0.01)
+    plane_b = FleetPlane(cluster, "rep-b", publish_interval_s=0.01)
+    disp_a = PartitionDispatcher(cl_a, TARGET, k=4)
+    disp_b = PartitionDispatcher(cl_b, TARGET, k=4)
+    disp_a.set_fleet(plane_a)
+    disp_b.set_fleet(plane_b)
+    # both replicas know the same device ids (breakers created lazily)
+    for d in range(4):
+        disp_a.breaker(d)
+        disp_b.breaker(d)
+    plane_a.start()
+    plane_b.start()
+    try:
+        for _ in range(3):
+            disp_a.breaker(1).record_failure()
+        assert disp_a.breaker(1).state == OPEN
+        import time as _t
+
+        deadline = _t.monotonic() + 5.0
+        while (
+            disp_b.breaker(1).state != HALF_OPEN
+            and _t.monotonic() < deadline
+        ):
+            _t.sleep(0.02)
+        assert disp_b.breaker(1).state == HALF_OPEN  # adopted the trip
+        for d in (0, 2, 3):
+            assert disp_b.breaker(d).state == CLOSED  # untouched
+        # the registered names surface in stats.fleet
+        assert "device:validation:1" in plane_b.snapshot()["breakers"]
+    finally:
+        plane_a.stop()
+        plane_b.stop()
+        disp_a.close()
+        disp_b.close()
+
+
+def test_dispatcher_plan_rebuilds_on_churn_and_quarantine():
+    cl = build_battery_client(8)
+    disp = PartitionDispatcher(cl, TARGET, k=4)
+    plan1 = disp.plan()
+    assert plan1 is not None and len(plan1.partitions) == 4
+    assert disp.plan() is plan1  # cached while nothing changed
+    # constraint churn rebuilds deterministically
+    cl.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "PartReq",
+        "metadata": {"name": "churn"},
+        "spec": {"parameters": {"labels": ["owner"]}},
+    })
+    plan2 = disp.plan()
+    assert plan2 is not plan1
+    assert sum(len(p.keys) for p in plan2.partitions) == 9
+    # manual quarantine re-homes; heal restores
+    disp.quarantine(2)
+    plan3 = disp.plan()
+    moved = [p for p in plan3.partitions if p.home_device == 2]
+    assert moved and all(p.device != 2 for p in moved)
+    assert disp.rehomes >= 1
+    disp.heal(2)
+    plan4 = disp.plan()
+    assert all(p.device == p.home_device for p in plan4.partitions)
+    disp.close()
+
+
+def test_restage_fault_backs_off_then_recovers():
+    clock = [0.0]
+    cl = build_battery_client(4)
+    disp = PartitionDispatcher(
+        cl, TARGET, k=4, clock=lambda: clock[0],
+        restage_backoff_s=1.0, metrics=MetricsRegistry(),
+    )
+    plan = disp.plan()
+    part = plan.partitions[1]
+    FAULTS.arm(device_point("driver.restage", part.device), mode="error",
+               count=1)
+    assert not disp.ensure_staged(part)  # fault: backoff armed
+    assert disp.restage_failures == 1
+    assert not disp.ensure_staged(part)  # inside backoff: no attempt
+    assert FAULTS.hits(device_point("driver.restage", part.device)) == 1
+    clock[0] = 1.5  # backoff elapsed; fault count exhausted
+    assert disp.ensure_staged(part)
+    assert disp.ensure_staged(part)  # cached staged token
+    disp.close()
+
+
+def test_all_dead_plan_flag():
+    cl = build_battery_client(4)
+    disp = PartitionDispatcher(cl, TARGET, k=2)
+    disp.quarantine(0)
+    disp.quarantine(1)
+    plan = disp.plan()
+    assert plan.all_dead
+    disp.heal(0)
+    assert not disp.plan().all_dead
+    disp.close()
