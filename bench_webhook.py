@@ -1128,12 +1128,186 @@ def run_fleet_bench(n_requests=1200, n_keys=24, err=sys.stderr):
     }
 
 
+_ATTR_LABELS_REGO = """package attrlabels
+
+violation[{"msg": msg}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("missing: %v", [missing])
+}
+"""
+
+_ATTR_REPOS_REGO = """package attrrepos
+
+violation[{"msg": msg}] {
+    image := input.review.object.spec.containers[_].image
+    not startswith(image, input.parameters.repo)
+    msg := sprintf("image outside allowed repo: %v", [image])
+}
+"""
+
+
+def build_attribution_client(driver, n_constraints):
+    """Self-contained policy load for the --attribution lane (no
+    reference-library dependency): three templates of DIFFERENT static
+    cost — a one-clause privileged check, a set-difference label check,
+    and a per-container repo prefix check — cycled across n
+    constraints, so the cost table has real weight variation to rank."""
+    from gatekeeper_tpu.constraint import Backend, K8sValidationTarget
+
+    client = Backend(driver).new_client(K8sValidationTarget())
+    mix = (
+        ("AttrPrivileged",
+         _CHAOS_REGO.replace("chaosbench", "attrprivileged"), None),
+        ("AttrLabels", _ATTR_LABELS_REGO, {"labels": ["app", "owner"]}),
+        ("AttrRepos", _ATTR_REPOS_REGO, {"repo": "nginx"}),
+    )
+    for kind, rego, _params in mix:
+        client.add_template({
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": kind.lower()},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": kind}}},
+                "targets": [{"target": TARGET, "rego": rego}],
+            },
+        })
+    for i in range(n_constraints):
+        kind, _rego, params = mix[i % len(mix)]
+        spec = {"match": {"kinds": [
+            {"apiGroups": [""], "kinds": ["Pod"]}
+        ]}}
+        if params is not None:
+            spec["parameters"] = params
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind,
+            "metadata": {"name": f"a{i:04d}"},
+            "spec": spec,
+        })
+    return client
+
+
+def _device_seconds_total(metrics):
+    """Sum of driver_phase_seconds{phase=device_dispatch} across label
+    sets — the measured device-execute total the attribution sums
+    check compares against."""
+    total = 0.0
+    for key, d in metrics.snapshot()["distributions"].items():
+        if key.startswith("driver_phase_seconds") and (
+            'phase="device_dispatch"' in key
+        ):
+            total += float(d["sum"])
+    return total
+
+
+def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
+                          profile=False, err=sys.stderr):
+    """The `--attribution` lane (docs/observability.md §Cost
+    attribution): run the constraint ladder through the partitioned
+    micro-batching handler with the CostAttributor wired, and report
+    per rung (a) the top-10 costliest constraints — item 1's pruning
+    target list — and (b) the sums check: attributed per-constraint
+    device seconds vs the measured device-execute total (must agree
+    within 10%; the model changes WHO is charged, never HOW MUCH).
+    `--profile` additionally captures a JAX/XPlane device profile
+    DURING the largest rung's measured replay."""
+    from gatekeeper_tpu.constraint import TpuDriver
+    from gatekeeper_tpu.control.runner import capture_jax_profile
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.obs import CostAttributor
+    from gatekeeper_tpu.parallel.partition import PartitionDispatcher
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    out = []
+    prof = None
+    for n_con in rungs:
+        metrics = MetricsRegistry()
+        driver = TpuDriver()
+        driver.set_metrics(metrics)
+        attributor = CostAttributor(metrics=metrics)
+        driver.set_attributor(attributor)
+        client = build_attribution_client(driver, n_con)
+        disp = PartitionDispatcher(
+            client, TARGET, k=min(k, n_con), metrics=metrics
+        )
+        batcher = MicroBatcher(
+            client, TARGET, window_ms=2.0, metrics=metrics,
+            partitioner=disp,
+        )
+        handler = BatchedValidationHandler(batcher, request_timeout=60)
+        batcher.start()
+        try:
+            _warm_route(client)
+            replay(handler, [make_request(i) for i in range(256)], 64)
+            replay(handler, [make_request(i) for i in range(512)], 128)
+            attributor.reset()
+            dev0 = _device_seconds_total(metrics)
+            capture = []
+            if profile and n_con == max(rungs):
+                # one XPlane capture riding the measured replay: the
+                # profile shows the fused dispatch under REAL load, not
+                # an idle device (the --enable-pprof endpoint's bench
+                # counterpart; single rung, single capture)
+                th = ThreadPoolExecutor(max_workers=1)
+                fut = th.submit(capture_jax_profile, 2.0)
+                capture.append((th, fut))
+            n_sub = max(400, n_requests // 3)
+            r = replay(
+                handler, [make_request(i) for i in range(n_sub)], 128
+            )
+            for th, fut in capture:
+                prof = fut.result(timeout=90)
+                th.shutdown(wait=False)
+            measured = _device_seconds_total(metrics) - dev0
+            attributed = attributor.snapshot()["total_device_seconds"]
+            top = attributor.top(10)
+            sums_ok = bool(
+                measured > 0
+                and abs(attributed - measured) <= 0.10 * measured
+            )
+            rung = {
+                "constraints": n_con,
+                "partitions": min(k, n_con),
+                "replay": {
+                    key: r[key]
+                    for key in ("requests", "throughput_rps",
+                                "p50_ms", "p99_ms")
+                },
+                "measured_device_seconds": round(measured, 6),
+                "attributed_device_seconds": round(attributed, 6),
+                "attribution_ratio": (
+                    round(attributed / measured, 4) if measured else None
+                ),
+                "sums_ok": sums_ok,
+                "top_costs": top,
+            }
+            out.append(rung)
+            top3 = [f"{t['kind']}/{t['name']}" for t in top[:3]]
+            print(
+                f"attribution rung c={n_con}: measured="
+                f"{measured:.4f}s attributed={attributed:.4f}s "
+                f"sums_ok={sums_ok} top={top3}",
+                file=err,
+            )
+        finally:
+            batcher.stop()
+            disp.close()
+    return {"rungs": out, "profile": prof}
+
+
 # the reference harness's constraint-count ladder
 # (pkg/webhook/policy_benchmark_test.go:265-276)
 LADDER = (5, 10, 50, 100, 200, 1000, 2000)
 
 
-def run_constraint_ladder(err=sys.stderr, rungs=LADDER, budget_s=None):
+def run_constraint_ladder(err=sys.stderr, rungs=LADDER, budget_s=None,
+                          profile=False):
     """Latency-vs-policy-count curve (VERDICT r4 #3): p50/p99/rps per
     constraint-count rung for all three serving paths — the serial
     Python-interpreter handler (the reference's architecture, measured
@@ -1255,12 +1429,32 @@ def run_constraint_ladder(err=sys.stderr, rungs=LADDER, budget_s=None):
             try:
                 _warm_route(client)
                 replay(handler, [make_request(i) for i in range(512)], 128)
+                capture = None
+                if profile and not any("profile" in r for r in out):
+                    # --profile: one JAX/XPlane capture riding THIS
+                    # rung's measured fused replay — a device profile
+                    # under real load, not an idle trace (the
+                    # /debug/profile endpoint's ladder counterpart)
+                    from gatekeeper_tpu.control.runner import (
+                        capture_jax_profile,
+                    )
+
+                    _pex = ThreadPoolExecutor(max_workers=1)
+                    capture = (_pex, _pex.submit(capture_jax_profile, 2.0))
                 n_sub = 1500
                 r = replay(handler, [make_request(i) for i in range(n_sub)], 128)
                 rung["fused"] = {
                     k: r[k]
                     for k in ("requests", "throughput_rps", "p50_ms", "p99_ms")
                 }
+                if capture is not None:
+                    _pex, fut = capture
+                    rung["profile"] = fut.result(timeout=90)
+                    _pex.shutdown(wait=False)
+                    print(
+                        f"ladder profile captured: {rung['profile']}",
+                        file=err,
+                    )
             finally:
                 batcher.stop()
 
@@ -1437,6 +1631,28 @@ def _summarize(mode, res):
                 rungs=len(rungs), skipped=res.get("skipped"),
                 last=rungs[-1] if rungs else None,
             )
+            prof = next(
+                (r["profile"] for r in rungs if r.get("profile")), None
+            )
+            if prof:
+                head["profile_trace_dir"] = prof.get("trace_dir")
+        elif mode == "attribution":
+            rungs = res.get("rungs") or []
+            head["rungs"] = len(rungs)
+            head["sums_ok"] = all(r.get("sums_ok") for r in rungs)
+            if rungs:
+                last = max(rungs, key=lambda r: r["constraints"])
+                head["constraints"] = last["constraints"]
+                head["attribution_ratio"] = last.get("attribution_ratio")
+                # the acceptance headline: the top-10 costliest
+                # constraints at the largest rung — item 1's target list
+                head["top10"] = [
+                    f"{t['kind']}/{t['name']}"
+                    for t in (last.get("top_costs") or [])[:10]
+                ]
+            prof = res.get("profile")
+            if prof:
+                head["profile_trace_dir"] = prof.get("trace_dir")
         elif isinstance(res, dict):
             phases = res.get("phases")
             if isinstance(phases, list) and phases:
@@ -1509,10 +1725,26 @@ if __name__ == "__main__":
         print(json.dumps(res))
         print(summarize_soak(res))
     elif "--ladder" in sys.argv:
-        rows, skipped = run_constraint_ladder()
+        rows, skipped = run_constraint_ladder(
+            profile="--profile" in sys.argv
+        )
         res = {"rungs": rows, "skipped": skipped}
         print(json.dumps(res))
         print(_summarize("ladder", res))
+    elif "--attribution" in sys.argv:
+        pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n_req = int(pos[0]) if pos else 1_200
+        rungs = (
+            tuple(int(x) for x in pos[1].split(","))
+            if len(pos) > 1
+            else (10, 50, 200)
+        )
+        res = run_attribution_bench(
+            rungs=rungs, n_requests=n_req,
+            profile="--profile" in sys.argv,
+        )
+        print(json.dumps(res))
+        print(_summarize("attribution", res))
     elif "--chaos" in sys.argv:
         pos = [a for a in sys.argv[1:] if not a.startswith("--")]
         n_req = int(pos[0]) if pos else 3_000

@@ -55,17 +55,24 @@ class AgentReviewHandler:
         self.log = logger if logger is not None else null_logger()
         self.fail_policy = fail_policy
         self.request_timeout = request_timeout
-        self.denied_log: List[Dict[str, Any]] = []
+        # bounded ring (matching ValidationHandler): a sustained-deny
+        # agent plane must churn this, never grow it
+        from collections import deque
+
+        self.denied_log: Any = deque(maxlen=4096)
 
     # -- entry ---------------------------------------------------------------
 
-    def handle(self, request: Dict[str, Any]) -> AdmissionResponse:
+    def handle(
+        self, request: Dict[str, Any], trace_id: Optional[str] = None
+    ) -> AdmissionResponse:
         from ..obs import start_span
 
         t0 = time.perf_counter()
         with start_span(
             self.tracer,
             "agent_handler",
+            trace_id=trace_id,
             tool=str(request.get("tool", "")),
             agent=str(request.get("agent", "")),
             session=str(request.get("session", "")),
@@ -89,6 +96,7 @@ class AgentReviewHandler:
             self.metrics.observe(
                 "agent_review_duration_seconds",
                 time.perf_counter() - t0,
+                exemplar=getattr(span, "trace_id", None),
                 admission_status=status,
             )
         return resp

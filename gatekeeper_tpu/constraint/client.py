@@ -394,14 +394,16 @@ class Client:
         return out
 
     def review_many_subset(
-        self, objs: Sequence[Any], subset, device: int = 0
+        self, objs: Sequence[Any], subset, device: int = 0,
+        partition=None,
     ) -> List[Responses]:
         """Partition-scoped batched review (docs/robustness.md §Fault
         domains): one driver dispatch evaluating ONLY `subset`'s
         constraints (keys per `driver.constraint_key`), attributed to
         logical `device`. The partitioned MicroBatcher fans a batch out
         over a PartitionPlan's subsets and merges the per-partition
-        results back into the monolithic order."""
+        results back into the monolithic order. `partition` labels the
+        cost-attribution rows (defaults to the device id)."""
         out: List[Responses] = [Responses() for _ in objs]
         for name, handler in self.targets.items():
             idxs: List[int] = []
@@ -415,7 +417,8 @@ class Client:
             if not inputs:
                 continue
             resps = self._driver.query_many_subset(
-                f'hooks["{name}"].violation', inputs, subset, device=device
+                f'hooks["{name}"].violation', inputs, subset,
+                device=device, partition=partition,
             )
             for i, resp in zip(idxs, resps):
                 for r in resp.results:

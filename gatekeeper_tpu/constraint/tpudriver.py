@@ -284,6 +284,13 @@ class TpuDriver(RegoDriver):
         # batch plane for external_data lookups — key prefetch per
         # micro-batch + the extdata row-feature screen
         self.external_data = None
+        # obs.CostAttributor (set_attributor): per-constraint
+        # device-time accounting — every dispatch's measured
+        # device-execute window is apportioned over the constraints it
+        # evaluated by the static cost model (_static_cost), labeled
+        # with the partition that paid it (docs/observability.md
+        # §Cost attribution)
+        self.attributor = None
 
     # -- module/data bookkeeping (cache invalidation) ------------------------
 
@@ -391,6 +398,58 @@ class TpuDriver(RegoDriver):
             self.kernel.metrics = metrics
         for (_t, kind), rep in self._analysis.items():
             self._export_verdict(kind, rep)
+
+    def set_attributor(self, attributor) -> None:
+        """Wire an obs.CostAttributor: from here on every dispatch's
+        device-execute time is apportioned per constraint."""
+        self.attributor = attributor
+
+    @staticmethod
+    def _static_cost(program) -> float:
+        """Analyzer/compiler-derived static cost weight for one
+        constraint: program expression rows (the compiled DAG's
+        structural signature length plus its constant-tensor payload)
+        × row-feature width (each per-row feature plane is another
+        device-resident operand the dispatch streams). Interpreter-
+        routed constraints (program None) weigh a flat 1 — they cost
+        HOST time per matching pair; their device share should read
+        ~0, but they must still appear in the table so the target list
+        for pruning is complete."""
+        if program is None:
+            return 1.0
+        rows = max(1, len(program.signature))
+        consts = 0
+        try:
+            consts = sum(
+                int(np.size(v)) for v in program.consts.values()
+            )
+        except Exception:
+            pass
+        width = 1 + len(program.row_features)
+        return float((rows + consts) * width)
+
+    def _attribute_dispatch(
+        self, cs, device_seconds: float, partition
+    ) -> None:
+        """Feed one measured device-execute window to the attributor,
+        apportioned over `cs`'s constraints by static weight. Called
+        under the serving mutex — the attributor does dict math only."""
+        if self.attributor is None or device_seconds <= 0.0:
+            return
+        try:
+            entries = []
+            for c, prog in zip(cs.constraints, cs.programs):
+                meta = c.get("metadata") or {}
+                entries.append((
+                    str(c.get("kind", "")),
+                    str(meta.get("name", "")),
+                    self._static_cost(prog),
+                ))
+            self.attributor.note_dispatch(
+                entries, device_seconds, partition=partition
+            )
+        except Exception:
+            pass  # accounting must never fail a dispatch
 
     def template_report(
         self, target: str, kind: str
@@ -1317,7 +1376,8 @@ class TpuDriver(RegoDriver):
     # -- partitioned dispatch (docs/robustness.md §Fault domains) ------------
 
     def query_many_subset(
-        self, path: str, inputs: Sequence[Any], subset, device: int = 0
+        self, path: str, inputs: Sequence[Any], subset, device: int = 0,
+        partition=None,
     ) -> List[Response]:
         """Partition-scoped fused dispatch: evaluate ONLY `subset`'s
         constraints for every input, as one device execution attributed
@@ -1376,7 +1436,8 @@ class TpuDriver(RegoDriver):
                     ]
                 autorejects.append(out)
             split = self._eval_reviews_split(
-                target, reviews, None, None, cset=cs
+                target, reviews, None, None, cset=cs,
+                partition=(partition if partition is not None else device),
             )
         return [
             Response(target=target, results=auto + ev)
@@ -1623,6 +1684,7 @@ class TpuDriver(RegoDriver):
         corpus: Optional[_Corpus],
         require_compiled: bool = False,
         cset: Optional[_ConstraintSet] = None,
+        partition=None,
     ) -> List[List[Result]]:
         """Shared compiled-path evaluation: match x programs on device,
         interpreter rendering of the sparse violating pairs; results
@@ -1631,7 +1693,8 @@ class TpuDriver(RegoDriver):
         escapes (before any result is produced) when this batch's shape
         bucket has no compiled entry yet. `cset` overrides the target's
         full constraint set with a partition-scoped one
-        (query_many_subset)."""
+        (query_many_subset); `partition` labels the cost-attribution
+        rows this dispatch's device time lands in."""
         import time as _time
 
         t_start = _time.perf_counter()
@@ -1738,6 +1801,12 @@ class TpuDriver(RegoDriver):
                 "device_dispatch": t_dispatched - t_encoded,
                 "render": t_done - t_dispatched,
             }
+            # per-constraint device-time accounting: the measured
+            # device-execute window, apportioned by static cost over
+            # the constraint set this dispatch evaluated
+            self._attribute_dispatch(
+                cs, phase_seconds["device_dispatch"], partition
+            )
             self.stats = {
                 "compiled_pairs": stat_c,
                 "interp_pairs": stat_i,

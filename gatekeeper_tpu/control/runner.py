@@ -124,7 +124,7 @@ class Runner:
         drain_grace_s: float = 0.0,
     ):
         from ..logs import null_logger
-        from ..obs import Tracer
+        from ..obs import CostAttributor, FlightRecorder, Tracer
 
         self.tracer = tracer if tracer is not None else Tracer()
 
@@ -146,6 +146,24 @@ class Runner:
         set_m = getattr(driver, "set_metrics", None)
         if set_m is not None:
             set_m(metrics)
+        # cost-attribution + flight-recorder plane
+        # (docs/observability.md): per-constraint device-time
+        # accounting at the driver's dispatch seam, served at
+        # /debug/costs; trip-triggered postmortems (breaker OPEN,
+        # quarantine, shed burst) at /debug/flightrecords, on disk
+        # when GATEKEEPER_TPU_FLIGHT_DIR is set
+        self.attributor = CostAttributor(
+            metrics=metrics, replica=pod_name
+        )
+        set_a = getattr(driver, "set_attributor", None)
+        if set_a is not None:
+            set_a(self.attributor)
+        self.recorder = FlightRecorder(
+            tracer=self.tracer,
+            attributor=self.attributor,
+            metrics=metrics,
+            replica=pod_name,
+        )
         self.excluder = Excluder()
         self.tracker = ReadinessTracker()
         self.switch = ControllerSwitch()
@@ -475,7 +493,29 @@ class Runner:
                 max_queue=self.max_queue,
                 drain_grace_s=self.drain_grace_s,
                 partitions=self.partitions or None,
+                recorder=self.recorder,
             )
+            # postmortem state sources: what a flight record snapshots
+            # alongside the trace tail / cost table / fault points
+            wh = self.webhook
+            self.recorder.add_source(
+                "webhook", lambda: {
+                    "draining": wh.draining,
+                    "shed": wh.batcher.shed_count,
+                    "batch_failures": wh.batcher.batch_failures,
+                    **(
+                        {"breaker": wh.batcher.breaker.snapshot()}
+                        if wh.batcher.breaker is not None
+                        else {}
+                    ),
+                },
+            )
+            if wh.partitioner is not None:
+                self.recorder.add_source(
+                    "partitions", wh.partitioner.postmortem
+                )
+            if self.fleet is not None:
+                self.recorder.add_source("fleet", self.fleet.snapshot)
             self.webhook.start()
             if (
                 self.fleet is not None
@@ -725,6 +765,8 @@ class Runner:
             self.webhook.stop()
         if self._readyz_httpd is not None:
             self._readyz_httpd.shutdown()
+        if self.recorder is not None:
+            self.recorder.stop()
         self.watch_mgr.stop()
         if self._warm_thread is not None:
             self._warm_thread.join(timeout=10)
@@ -741,11 +783,7 @@ class Runner:
         directory (open with TensorBoard / xprof) or an error. One
         capture at a time (the profiler rejects nesting). Concurrent
         device work — sweeps, webhook dispatches — lands in the trace."""
-        import tempfile
-        import time as _time
         from urllib.parse import parse_qs, urlparse
-
-        import jax
 
         try:
             q = parse_qs(urlparse(path).query)
@@ -754,18 +792,14 @@ class Runner:
             return 400, json.dumps(
                 {"error": "bad seconds parameter"}
             ).encode()
-        seconds = max(0.0, min(seconds, 60.0))
         if not self._profile_lock.acquire(blocking=False):
             return 409, json.dumps(
                 {"error": "a profile capture is already running"}
             ).encode()
         try:
-            out_dir = tempfile.mkdtemp(prefix="gk-jaxprof-")
-            with jax.profiler.trace(out_dir):
-                _time.sleep(seconds)
-            return 200, json.dumps({"trace_dir": out_dir}).encode()
-        except Exception as e:
-            return 500, json.dumps({"error": str(e)}).encode()
+            doc = capture_jax_profile(seconds)
+            code = 500 if "error" in doc else 200
+            return code, json.dumps(doc).encode()
         finally:
             self._profile_lock.release()
 
@@ -879,17 +913,42 @@ class Runner:
                                 drv, "cold_batches", 0
                             ),
                         }
+                    # cost-attribution + flight-recorder headlines
+                    # (full payloads live at /debug/costs and
+                    # /debug/flightrecords)
+                    stats["obs"] = {
+                        "costs": runner.attributor.snapshot(),
+                        "flightrecords": runner.recorder.snapshot(),
+                    }
                     payload = json.dumps(
                         {"ready": ok, "stats": stats}
                     ).encode()
                     self.send_response(200 if ok else 503)
                 elif self.path.split("?")[0] == "/debug/traces":
-                    # recent request/sweep traces (docs/observability.md)
-                    from ..metrics.registry import _traces_n
+                    # recent request/sweep traces — ?trace_id=/?limit=/
+                    # ?format=otlp (docs/observability.md)
+                    from ..metrics.registry import export_traces
 
-                    payload = runner.tracer.export_json(
-                        n=_traces_n(self.path)
+                    payload = export_traces(
+                        runner.tracer, self.path
                     ).encode()
+                    self.send_response(200)
+                elif self.path.split("?")[0] == "/debug/costs":
+                    # per-constraint device-time cost table, sorted
+                    # costliest-first with share-of-plane fractions
+                    # (docs/observability.md §Cost attribution)
+                    from ..metrics.registry import _debug_costs_k
+
+                    payload = json.dumps(
+                        runner.attributor.table(
+                            _debug_costs_k(self.path)
+                        )
+                    ).encode()
+                    self.send_response(200)
+                elif self.path == "/debug/flightrecords":
+                    # trip-triggered postmortem captures, newest first
+                    # (docs/observability.md §Flight recorder)
+                    payload = runner.recorder.export_json().encode()
                     self.send_response(200)
                 elif self.path == "/healthz":
                     payload = b'{"ok": true}'
@@ -901,7 +960,7 @@ class Runner:
                     code, payload = runner._capture_profile(self.path)
                     self.send_response(code)
                 else:
-                    payload = b"not found"
+                    payload = b'{"error": "not found"}'
                     self.send_response(404)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
@@ -918,6 +977,28 @@ class Runner:
         threading.Thread(
             target=self._readyz_httpd.serve_forever, daemon=True
         ).start()
+
+
+def capture_jax_profile(seconds: float) -> Dict[str, Any]:
+    """One JAX profiler (XPlane) capture of `seconds` of live device
+    work, written to a fresh temp directory (open with TensorBoard /
+    xprof). Shared by the Runner's /debug/profile endpoint and
+    `bench_webhook.py --profile` (the ladder-rung capture); callers
+    own their own single-flight locking — the profiler itself rejects
+    nesting."""
+    import tempfile
+    import time as _time
+
+    seconds = max(0.0, min(float(seconds), 60.0))
+    try:
+        import jax
+
+        out_dir = tempfile.mkdtemp(prefix="gk-jaxprof-")
+        with jax.profiler.trace(out_dir):
+            _time.sleep(seconds)
+        return {"trace_dir": out_dir, "seconds": seconds}
+    except Exception as e:
+        return {"error": str(e)}
 
 
 def load_yaml_dir(cluster: FakeCluster, path: str) -> int:

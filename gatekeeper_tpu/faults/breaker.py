@@ -61,6 +61,9 @@ class CircuitBreaker:
         # of assuming one breaker per plane
         device=None,
         name: Optional[str] = None,
+        # obs.FlightRecorder: a transition to OPEN trips a postmortem
+        # capture (trigger() is queue-and-wake, safe under this lock)
+        recorder=None,
     ):
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
@@ -81,6 +84,7 @@ class CircuitBreaker:
             self._tags["device"] = self.device
         self.metrics = metrics
         self.tracer = tracer
+        self.recorder = recorder
         self._clock = clock
         self._lock = threading.Lock()
         self._state = CLOSED
@@ -160,6 +164,17 @@ class CircuitBreaker:
                 "breaker_transition", breaker=self.name, **self._tags,
                 from_state=from_state, to_state=to_state,
             ):
+                pass
+        if self.recorder is not None and to_state == OPEN:
+            # trip-triggered postmortem (docs/observability.md §Flight
+            # recorder): trigger() only enqueues — safe under this lock
+            try:
+                self.recorder.trigger(
+                    "breaker_open", breaker=self.name, **self._tags,
+                    from_state=from_state, to_state=to_state,
+                    consecutive_failures=self._consecutive_failures,
+                )
+            except Exception:
                 pass
 
     def _export_state(self) -> None:

@@ -1,14 +1,35 @@
 """In-process metrics: counters, gauges, distributions with optional
-histogram buckets; Prometheus text format exposition over stdlib HTTP."""
+histogram buckets; Prometheus text format exposition (with OpenMetrics
+trace-id exemplars) over stdlib HTTP. Per-family series cardinality is
+capped (`max_series_per_family`) so an unbounded label — pathological
+constraint churn under `constraint_device_seconds_total{kind,name}` —
+drops new series (counted in `metrics_dropped_series_total`) instead
+of growing the registry without bound."""
 
 from __future__ import annotations
 
 import bisect
+import json
+import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_log = logging.getLogger("gatekeeper_tpu.metrics")
+
+# per-family live-series cap (env-overridable): the registry's defense
+# against label-cardinality leaks — the soak leak sampler watches
+# series_count(), this cap is what makes that curve provably bounded
+DEFAULT_MAX_SERIES_PER_FAMILY = int(
+    os.environ.get("GATEKEEPER_TPU_METRICS_MAX_SERIES", "512")
+)
+
+# the drop accounting must never itself be droppable (it is one series
+# per capped family — bounded by the family-name universe, not labels)
+_DROP_FAMILY = "metrics_dropped_series_total"
 
 # Default latency buckets for *_seconds distributions (14 finite bounds
 # + +Inf at exposition). Spans 100µs..30s: the fused admission path p50
@@ -35,8 +56,12 @@ class _Dist:
     # cumulation happens at exposition. None = plain summary.
     bounds: Optional[Tuple[float, ...]] = None
     bucket_counts: Optional[List[int]] = None
+    # last (trace_id, value, wall ts) exemplar per bucket — the
+    # OpenMetrics hook connecting a latency bucket to the trace that
+    # landed in it (docs/observability.md §Exemplars)
+    exemplars: Optional[List[Optional[Tuple[str, float, float]]]] = None
 
-    def add(self, v: float) -> None:
+    def add(self, v: float, exemplar: Optional[str] = None) -> None:
         self.count += 1
         self.total += v
         self.minimum = min(self.minimum, v)
@@ -44,7 +69,12 @@ class _Dist:
         if self.bounds is not None:
             # index of the first bound >= v (le semantics); v above the
             # last bound lands in the trailing +Inf slot
-            self.bucket_counts[bisect.bisect_left(self.bounds, v)] += 1
+            idx = bisect.bisect_left(self.bounds, v)
+            self.bucket_counts[idx] += 1
+            if exemplar is not None:
+                if self.exemplars is None:
+                    self.exemplars = [None] * len(self.bucket_counts)
+                self.exemplars[idx] = (str(exemplar), v, time.time())
 
 
 class MetricsRegistry:
@@ -57,13 +87,59 @@ class MetricsRegistry:
     override per metric with `set_buckets` (before the first sample) or
     pass `buckets=()` to keep a bucketless summary."""
 
-    def __init__(self):
+    def __init__(self, max_series_per_family: Optional[int] = None):
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple], float] = {}
         self._gauges: Dict[Tuple[str, Tuple], float] = {}
         self._dists: Dict[Tuple[str, Tuple], _Dist] = {}
         self._bucket_conf: Dict[str, Tuple[float, ...]] = {}
         self._help: Dict[str, str] = {}
+        # cardinality guard state: live series per family, dropped
+        # series attempts per family, families already warned about
+        self.max_series_per_family = (
+            DEFAULT_MAX_SERIES_PER_FAMILY
+            if max_series_per_family is None
+            else int(max_series_per_family)
+        )
+        self._family_counts: Dict[str, int] = {}
+        self._dropped: Dict[str, int] = {}
+        self._warned: set = set()
+
+    def _admit_locked(self, store: Dict, key: Tuple[str, Tuple]) -> bool:
+        """Cardinality guard (caller holds the lock): an EXISTING
+        series always updates; a NEW series is admitted only while its
+        family is under the cap. The caller accounts a refusal via
+        `_note_dropped` AFTER releasing the lock."""
+        if key in store:
+            return True
+        name = key[0]
+        if (
+            name != _DROP_FAMILY
+            and self._family_counts.get(name, 0)
+            >= self.max_series_per_family
+        ):
+            return False
+        self._family_counts[name] = self._family_counts.get(name, 0) + 1
+        return True
+
+    def _note_dropped(self, name: str) -> None:
+        """Account one capped insert: the per-family drop counter —
+        one bounded series per capped family, exempt from the cap
+        itself — plus a once-per-family warning log."""
+        warn = False
+        with self._lock:
+            self._dropped[name] = self._dropped.get(name, 0) + 1
+            if name not in self._warned:
+                self._warned.add(name)
+                warn = True
+        self.record("metrics_dropped_series_total", 1, family=name)
+        if warn:
+            _log.warning(
+                "metric family %r hit the %d-series cardinality cap; "
+                "dropping new label sets (see "
+                "metrics_dropped_series_total)",
+                name, self.max_series_per_family,
+            )
 
     # -- configuration -------------------------------------------------------
 
@@ -87,33 +163,55 @@ class MetricsRegistry:
 
     # -- write ---------------------------------------------------------------
 
-    def record(self, name: str, value: float = 1, **tags) -> None:
-        """Add to a counter."""
+    def record(self, name: str, value: float = 1, /, **tags) -> None:
+        """Add to a counter. `name`/`value` are positional-only so a
+        LABEL may itself be called `name` (the cost-attribution series
+        tags constraints by kind + name)."""
         key = (name, _tag_key(tags))
         with self._lock:
-            self._counters[key] = self._counters.get(key, 0) + value
+            admitted = self._admit_locked(self._counters, key)
+            if admitted:
+                self._counters[key] = self._counters.get(key, 0) + value
+        if not admitted:
+            self._note_dropped(name)
 
-    def gauge(self, name: str, value: float, **tags) -> None:
+    def gauge(self, name: str, value: float, /, **tags) -> None:
         key = (name, _tag_key(tags))
         with self._lock:
-            self._gauges[key] = value
+            admitted = self._admit_locked(self._gauges, key)
+            if admitted:
+                self._gauges[key] = value
+        if not admitted:
+            self._note_dropped(name)
 
-    def observe(self, name: str, value: float, **tags) -> None:
-        """Add a sample to a distribution (latency histograms)."""
+    def observe(
+        self, name: str, value: float, /, exemplar: Optional[str] = None,
+        **tags,
+    ) -> None:
+        """Add a sample to a distribution (latency histograms).
+        `exemplar` attaches a trace id to the sample's bucket, exposed
+        in OpenMetrics exemplar syntax — the hop from a p99 bucket to
+        the exact trace that landed in it."""
         key = (name, _tag_key(tags))
         with self._lock:
             d = self._dists.get(key)
             if d is None:
-                bounds = self._bounds_for(name)
-                d = self._dists[key] = _Dist(
-                    bounds=bounds,
-                    bucket_counts=(
-                        [0] * (len(bounds) + 1)
-                        if bounds is not None
-                        else None
-                    ),
-                )
-            d.add(value)
+                if not self._admit_locked(self._dists, key):
+                    d = None
+                else:
+                    bounds = self._bounds_for(name)
+                    d = self._dists[key] = _Dist(
+                        bounds=bounds,
+                        bucket_counts=(
+                            [0] * (len(bounds) + 1)
+                            if bounds is not None
+                            else None
+                        ),
+                    )
+            if d is not None:
+                d.add(value, exemplar=exemplar)
+        if d is None:
+            self._note_dropped(name)
 
     def timed(self, name: str, **tags):
         """Context manager: records elapsed seconds into `name`, tagged
@@ -149,6 +247,14 @@ class MetricsRegistry:
             return (
                 len(self._counters) + len(self._gauges) + len(self._dists)
             )
+
+    def dropped_series(self) -> Dict[str, int]:
+        """{family -> new-series inserts dropped by the cardinality
+        cap}. Non-empty means a label set outgrew
+        `max_series_per_family` — the soak sampler records the total so
+        a capped (bounded) registry is distinguishable from a leak."""
+        with self._lock:
+            return dict(self._dropped)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -246,14 +352,28 @@ class MetricsRegistry:
                 base = self._fmt((name, tags))
                 if d.bounds is not None:
                     cum = _cumulate(d.bucket_counts)
-                    for bound, c in zip(d.bounds, cum):
+
+                    def _ex(idx: int) -> str:
+                        # OpenMetrics exemplar: `# {trace_id="…"} v ts`
+                        # appended to the bucket the sample landed in
+                        if d.exemplars is None or d.exemplars[idx] is None:
+                            return ""
+                        tid, val, ts = d.exemplars[idx]
+                        return (
+                            f' # {{trace_id="{self._escape(tid)}"}}'
+                            f" {_fnum(float(val))} {_fnum(float(ts))}"
+                        )
+
+                    for i, (bound, c) in enumerate(zip(d.bounds, cum)):
                         series = self._suffixed(
                             base, "_bucket",
                             f'le="{_fnum(float(bound))}"',
                         )
-                        lines.append(f"{prefix}{series} {c}")
+                        lines.append(f"{prefix}{series} {c}{_ex(i)}")
                     inf = self._suffixed(base, "_bucket", 'le="+Inf"')
-                    lines.append(f"{prefix}{inf} {d.count}")
+                    lines.append(
+                        f"{prefix}{inf} {d.count}{_ex(len(d.bounds))}"
+                    )
                 lines.append(
                     f"{prefix}{self._suffixed(base, '_count')} {d.count}"
                 )
@@ -286,30 +406,42 @@ def serve_metrics(
     port: int = 0,
     bind_addr: str = "127.0.0.1",
     tracer=None,
+    attributor=None,
+    recorder=None,
 ) -> ThreadingHTTPServer:
     """Serve /metrics (Prometheus text) on a background thread; returns
     the server (server_address[1] carries the bound port). The reference
     serves the same on --prometheus-port 8888; in-cluster runs bind
     0.0.0.0 so Prometheus can scrape the pod IP (run.py wires this).
-    With a tracer, /debug/traces serves the recent-trace ring as JSON
-    (?n= bounds the count) on the same plane."""
+    With a tracer, /debug/traces serves the trace ring (?trace_id= /
+    ?limit= / ?format=otlp — docs/observability.md); an attributor adds
+    /debug/costs (the top-K cost table) and a flight recorder adds
+    /debug/flightrecords — the same debug trio the health plane serves."""
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802
-            if self.path == "/metrics":
+            route = self.path.split("?")[0]
+            if route == "/metrics":
                 payload = registry.prometheus_text().encode()
                 ctype = "text/plain; version=0.0.4"
-            elif (
-                tracer is not None
-                and self.path.split("?")[0] == "/debug/traces"
-            ):
-                payload = tracer.export_json(
-                    n=_traces_n(self.path)
+            elif tracer is not None and route == "/debug/traces":
+                payload = export_traces(tracer, self.path).encode()
+                ctype = "application/json"
+            elif attributor is not None and route == "/debug/costs":
+                payload = json.dumps(
+                    attributor.table(_debug_costs_k(self.path))
                 ).encode()
                 ctype = "application/json"
+            elif recorder is not None and route == "/debug/flightrecords":
+                payload = recorder.export_json().encode()
+                ctype = "application/json"
             else:
+                payload = b'{"error": "not found"}'
                 self.send_response(404)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
+                self.wfile.write(payload)
                 return
             self.send_response(200)
             self.send_header("Content-Type", ctype)
@@ -327,11 +459,40 @@ def serve_metrics(
 
 
 def _traces_n(path: str) -> int:
-    """?n= from a /debug/traces request path (default 50, clamped)."""
+    """?n=/?limit= from a /debug/traces request path (default 50,
+    clamped). `limit` is the documented name; `n` stays accepted."""
     from urllib.parse import parse_qs, urlparse
 
+    q = parse_qs(urlparse(path).query)
+    raw = (q.get("limit") or q.get("n") or ["50"])[0]
     try:
-        n = int(parse_qs(urlparse(path).query).get("n", ["50"])[0])
+        n = int(raw)
     except (ValueError, TypeError):
         n = 50
     return max(1, min(n, 1000))
+
+
+def _debug_costs_k(path: str) -> Optional[int]:
+    """?k= for /debug/costs (default 10; k=0 returns every row)."""
+    from urllib.parse import parse_qs, urlparse
+
+    try:
+        k = int(parse_qs(urlparse(path).query).get("k", ["10"])[0])
+    except (ValueError, TypeError):
+        k = 10
+    return None if k <= 0 else min(k, 10_000)
+
+
+def export_traces(tracer, path: str) -> str:
+    """The one /debug/traces renderer both HTTP planes (health +
+    metrics) share: ?trace_id= narrows to one trace, ?limit=/?n=
+    bounds the count, ?format=otlp switches to OTLP-JSON span export."""
+    from urllib.parse import parse_qs, urlparse
+
+    q = parse_qs(urlparse(path).query)
+    trace_id = (q.get("trace_id") or [None])[0] or None
+    n = _traces_n(path)
+    fmt = (q.get("format") or [""])[0].lower()
+    if fmt == "otlp":
+        return tracer.export_otlp(n=n, trace_id=trace_id)
+    return tracer.export_json(n=n, trace_id=trace_id)

@@ -1,21 +1,33 @@
-"""Observability: in-process tracing (spans, ring retention, JSON
-export) threaded through the admission and audit paths. See
+"""Observability: in-process tracing (spans, ring retention, JSON +
+OTLP export, W3C traceparent propagation), per-constraint device-time
+cost attribution, and the trip-triggered flight recorder. See
 docs/observability.md for the span taxonomy and wiring map."""
 
+from .attribution import MONO_PARTITION, CostAttributor
+from .flightrecorder import FlightRecorder
 from .tracer import (
     NOOP_SPAN,
     Span,
     SpanContext,
     Tracer,
+    derive_trace_id,
+    format_traceparent,
+    parse_traceparent,
     span_breakdown,
     start_span,
 )
 
 __all__ = [
     "NOOP_SPAN",
+    "MONO_PARTITION",
+    "CostAttributor",
+    "FlightRecorder",
     "Span",
     "SpanContext",
     "Tracer",
+    "derive_trace_id",
+    "format_traceparent",
+    "parse_traceparent",
     "span_breakdown",
     "start_span",
 ]

@@ -26,11 +26,66 @@ Design constraints that shaped it:
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
+import re
 import threading
 import time
 from typing import Any, Dict, List, NamedTuple, Optional
+
+# W3C Trace Context (https://www.w3.org/TR/trace-context/): version 00,
+# 32 lowercase-hex trace id, 16 lowercase-hex parent id, 2-hex flags.
+# All-zero ids are explicitly invalid per spec.
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[str]:
+    """The trace id from a W3C `traceparent` header, or None when the
+    header is absent/malformed (an invalid header MUST be ignored per
+    spec — the request then gets a derived or fresh trace id)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None or m.group(1) == "ff":
+        return None
+    tid = m.group(2)
+    if tid == "0" * 32 or m.group(3) == "0" * 16:
+        return None
+    return tid
+
+
+def derive_trace_id(seed: Optional[str]) -> Optional[str]:
+    """Deterministic 32-hex trace id from a stable request identity
+    (the admission UID): a caller that sent no `traceparent` still gets
+    a trace id any hop holding the same UID can reconstruct."""
+    if not seed:
+        return None
+    return hashlib.sha256(str(seed).encode()).hexdigest()[:32]
+
+
+def format_traceparent(trace_id: str, span_seed: str = "") -> str:
+    """A well-formed `traceparent` response header for `trace_id`
+    (padded/derived to 32 hex); the parent-id half is derived — this
+    engine's span ids are not 16-hex, and the header only needs to name
+    the trace, not a resumable span."""
+    tid = _otlp_id(trace_id, 32)
+    sid = hashlib.sha256((trace_id + span_seed).encode()).hexdigest()[:16]
+    return f"00-{tid}-{sid}-01"
+
+
+def _otlp_id(raw: Optional[str], width: int) -> str:
+    """Map an internal id to the fixed-width lowercase-hex form OTLP
+    requires: ids that are already hex (W3C-ingested trace ids) pass
+    through zero-padded; everything else hashes deterministically."""
+    if not raw:
+        return "0" * width
+    s = str(raw).lower()
+    if re.fullmatch(r"[0-9a-f]+", s) and len(s) <= width:
+        return s.zfill(width)
+    return hashlib.sha256(s.encode()).hexdigest()[:width]
 
 
 class SpanContext(NamedTuple):
@@ -280,8 +335,70 @@ class Tracer:
                     return {"trace_id": trace_id, "spans": list(t["spans"])}
         return None
 
-    def export_json(self, n: int = 50) -> str:
+    def export_json(self, n: int = 50, trace_id: Optional[str] = None) -> str:
+        """JSON export of the ring; `trace_id` narrows to one trace
+        (empty list when it is not retained) — the `/debug/traces?
+        trace_id=` lookup both HTTP planes serve."""
+        if trace_id is not None:
+            t = self.get(trace_id)
+            return json.dumps({"traces": [t] if t is not None else []})
         return json.dumps({"traces": self.recent(n)})
+
+    def export_otlp(self, n: int = 50, trace_id: Optional[str] = None) -> str:
+        """OTLP-JSON span export (`/debug/traces?format=otlp`): the ring
+        rendered as one resourceSpans/scopeSpans document an OTLP
+        collector's JSON receiver ingests directly. Internal ids map to
+        the 128/64-bit hex forms OTLP requires (W3C-ingested trace ids
+        pass through unchanged); span attrs become stringValue
+        attributes."""
+        if trace_id is not None:
+            t = self.get(trace_id)
+            traces = [t] if t is not None else []
+        else:
+            traces = self.recent(n)
+        spans = []
+        for tr in traces:
+            tid = _otlp_id(tr["trace_id"], 32)
+            for sp in tr.get("spans", []):
+                spans.append({
+                    "traceId": tid,
+                    "spanId": _otlp_id(sp.get("span_id"), 16),
+                    "parentSpanId": (
+                        _otlp_id(sp["parent_id"], 16)
+                        if sp.get("parent_id")
+                        else ""
+                    ),
+                    "name": sp.get("name", ""),
+                    "kind": 1,  # SPAN_KIND_INTERNAL
+                    "startTimeUnixNano": str(
+                        int(sp.get("start", 0.0) * 1e9)
+                    ),
+                    "endTimeUnixNano": str(int(sp.get("end", 0.0) * 1e9)),
+                    "status": {
+                        "code": 2 if sp.get("status") == "error" else 1
+                    },
+                    "attributes": [
+                        {
+                            "key": str(k),
+                            "value": {"stringValue": str(v)},
+                        }
+                        for k, v in (sp.get("attrs") or {}).items()
+                    ],
+                })
+        return json.dumps({
+            "resourceSpans": [{
+                "resource": {
+                    "attributes": [{
+                        "key": "service.name",
+                        "value": {"stringValue": "gatekeeper-tpu"},
+                    }],
+                },
+                "scopeSpans": [{
+                    "scope": {"name": "gatekeeper_tpu.obs"},
+                    "spans": spans,
+                }],
+            }],
+        })
 
     def size(self) -> Dict[str, int]:
         """Retention sizes (the soak leak sampler's view): completed

@@ -201,6 +201,9 @@ class PartitionDispatcher:
         # harness subscribes its transition ledger here)
         breaker_listener=None,
         probe_batch: int = 8,
+        # obs.FlightRecorder: per-device breaker OPENs and operator
+        # quarantines trip a postmortem capture (docs/observability.md)
+        recorder=None,
     ):
         self.client = client
         self.target = target
@@ -222,6 +225,7 @@ class PartitionDispatcher:
         self.probe_batch = probe_batch
         self._clock = clock
         self._breaker_listener = breaker_listener
+        self.recorder = recorder
         self._lock = threading.RLock()
         self._breakers: Dict[int, CircuitBreaker] = {}
         self._manual_quarantine: set = set()
@@ -261,6 +265,7 @@ class PartitionDispatcher:
                     metrics=self.metrics,
                     tracer=self.tracer,
                     clock=self._clock,
+                    recorder=self.recorder,
                 )
                 self._breakers[device] = b
         if created is not None:
@@ -304,6 +309,14 @@ class PartitionDispatcher:
         with self._lock:
             self._manual_quarantine.add(int(device))
         self._export_quarantine()
+        if self.recorder is not None:
+            try:
+                self.recorder.trigger(
+                    "device_quarantine", plane=self.plane,
+                    device=int(device), manual=True,
+                )
+            except Exception:
+                pass
 
     def heal(self, device: int) -> None:
         """Lift an operator quarantine (a breaker-driven quarantine
@@ -504,6 +517,28 @@ class PartitionDispatcher:
             ex.shutdown(wait=False)
 
     # -- introspection ---------------------------------------------------------
+
+    def postmortem(self) -> Dict[str, Any]:
+        """The flight-recorder source view: `snapshot()` PLUS each
+        partition's constraint keys and, explicitly, the keys belonging
+        to quarantined devices' HOME partitions — the "which constraints
+        did the sick chip take with it" answer a postmortem needs
+        without a live plan to interrogate."""
+        snap = self.snapshot()
+        with self._lock:
+            plan = self._plan
+        if plan is not None:
+            snap["partition_keys"] = {
+                str(p.index): list(p.keys) for p in plan.partitions
+            }
+            quarantined = set(snap.get("quarantined", ()))
+            snap["quarantined_constraint_keys"] = sorted({
+                k
+                for p in plan.partitions
+                if p.home_device in quarantined
+                for k in p.keys
+            })
+        return snap
 
     def snapshot(self) -> Dict[str, Any]:
         """Readyz/debug view: the plan, quarantine state, per-device

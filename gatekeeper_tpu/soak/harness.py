@@ -221,6 +221,8 @@ class _Replica:
         self.fleet_plane = None
         self.rotator = None
         self.partitioner = None  # device fault domains (partitions > 0)
+        self.attributor = None  # per-constraint device-time accounting
+        self.recorder = None  # trip-triggered postmortem capture
 
     @property
     def base_url(self) -> str:
@@ -247,6 +249,11 @@ class SoakHarness:
         self._t0 = time.monotonic()  # re-stamped at load start
         self._stop = threading.Event()
         self._saved_min_batch = None
+        # per-window SLO-breach detection (flight-recorder trigger):
+        # _submit counts outcomes, the sampler judges each window
+        self._win_lock = threading.Lock()
+        self._win_total = 0
+        self._win_failed = 0
         # client-side TLS: availability is what the soak measures; the
         # chain-validation contract is pinned by tests/test_fleet.py,
         # so the LB model skips verification and keeps serving across
@@ -288,7 +295,7 @@ class SoakHarness:
         from ..externaldata import ExternalDataSystem
         from ..metrics import MetricsRegistry
         from ..mutation import MutationSystem
-        from ..obs import Tracer
+        from ..obs import CostAttributor, FlightRecorder, Tracer
         from ..webhook.server import WebhookServer
 
         scn = self.scenario
@@ -299,6 +306,17 @@ class SoakHarness:
         rep.tracer = Tracer(max_traces=128)
         rep.driver = TpuDriver()
         rep.driver.set_metrics(rep.metrics)  # phase split + telemetry
+        # replica-tagged attribution + flight recorder: multi-replica
+        # runs stitch per-replica timelines from the replica field on
+        # records and cost tables (docs/observability.md)
+        rep.attributor = CostAttributor(metrics=rep.metrics, replica=name)
+        rep.driver.set_attributor(rep.attributor)
+        rep.recorder = FlightRecorder(
+            tracer=rep.tracer,
+            attributor=rep.attributor,
+            metrics=rep.metrics,
+            replica=name,
+        )
         rep.client = Backend(rep.driver).new_client(
             K8sValidationTarget(), AgentActionTarget()
         )
@@ -382,6 +400,16 @@ class SoakHarness:
             rotator=rotator,
             window_ms=scn.window_ms,
             request_timeout=max(5.0, scn.deadline_s * 8),
+            # denial records carry trace ids (the traceparent
+            # propagation acceptance reads them)
+            log_denies=True,
+            recorder=rep.recorder,
+        )
+        rep.recorder.add_source(
+            "webhook", lambda rep=rep: {
+                "shed": rep.server.batcher.shed_count,
+                "batch_failures": rep.server.batcher.batch_failures,
+            },
         )
         # scenario-tuned breakers (the stock 30 s recovery would spend
         # a whole fault window waiting): share metrics/tracer so the
@@ -424,6 +452,7 @@ class SoakHarness:
                 plane=plane,
                 metrics=rep.metrics,
                 tracer=rep.tracer,
+                recorder=rep.recorder,
             )
             batcher.breaker = breaker
             _ledger_subscribe(breaker, plane, name)
@@ -446,8 +475,10 @@ class SoakHarness:
                 breaker_listener=lambda b, replica=name: (
                     _ledger_subscribe(b, "validation", replica)
                 ),
+                recorder=rep.recorder,
             )
             rep.partitioner = disp
+            rep.recorder.add_source("partitions", disp.postmortem)
             rep.server.partitioner = disp  # server.stop() closes it
             rep.server.batcher.partitioner = disp
             rep.server.batcher.breaker = None
@@ -508,7 +539,17 @@ class SoakHarness:
 
     def _submit(self, plane: str):
         """One open-loop request: round-robin over ACTIVE replicas,
-        POST, classify. Returns (status, outcome) for the generator."""
+        POST, classify. Returns (status, outcome) for the generator.
+        Outcomes also feed the per-window SLO-breach detector (a bad
+        window trips a flight-recorder postmortem)."""
+        status, outcome = self._submit_once(plane)
+        with self._win_lock:
+            self._win_total += 1
+            if status != 200:
+                self._win_failed += 1
+        return status, outcome
+
+    def _submit_once(self, plane: str):
         live = [r for r in self.replicas if r.active]
         if not live:
             return 0, CONN_ERROR
@@ -689,7 +730,7 @@ class SoakHarness:
         diffs stay correct)."""
         shed = failures = cache_entries = cache_evictions = 0
         trace_ring = metrics_series = render_cache = 0
-        cert_gen = 0
+        cert_gen = metrics_dropped = 0
         for rep in self.replicas:
             for b in (
                 rep.server.batcher,
@@ -704,6 +745,12 @@ class SoakHarness:
             cache_evictions += rep.external.cache.evictions
             trace_ring += rep.tracer.size()["ring"]
             metrics_series += rep.metrics.series_count()
+            # the cardinality cap's drop count: series_count staying
+            # flat WITH drops accruing means the cap is holding (the
+            # bounded-registry evidence), not that churn stopped
+            metrics_dropped += sum(
+                rep.metrics.dropped_series().values()
+            )
             size_fn = getattr(rep.driver, "render_cache_size", None)
             if size_fn is not None:
                 render_cache += size_fn()
@@ -718,6 +765,7 @@ class SoakHarness:
             "cache_evictions": cache_evictions,
             "trace_ring": trace_ring,
             "metrics_series": metrics_series,
+            "metrics_dropped": metrics_dropped,
             "render_cache": render_cache,
             "rss_kb": self._rss_kb(),
             "cert_generation": cert_gen,
@@ -749,11 +797,28 @@ class SoakHarness:
                 "cache_evictions": cur["cache_evictions"],
                 "trace_ring": cur["trace_ring"],
                 "metrics_series": cur["metrics_series"],
+                "metrics_dropped": cur["metrics_dropped"],
                 "render_cache": cur["render_cache"],
                 "rss_kb": cur["rss_kb"],
                 "cert_generation": cur["cert_generation"],
             })
             prev = cur
+            # per-window SLO-breach detector: a window whose failure
+            # rate crosses the threshold trips one postmortem on every
+            # active replica (the recorders rate-limit the storm)
+            with self._win_lock:
+                total, failed = self._win_total, self._win_failed
+                self._win_total = self._win_failed = 0
+            if total >= 20 and failed / total > 0.2:
+                for rep in self.replicas:
+                    if rep.recorder is not None and rep.active:
+                        rep.recorder.trigger(
+                            "slo_window_breach",
+                            window=i,
+                            requests=total,
+                            failed=failed,
+                            failure_rate=round(failed / total, 4),
+                        )
             if self._stop.is_set():
                 return
 
@@ -860,6 +925,21 @@ class SoakHarness:
             capacity = run_capacity_model(
                 scn.capacity, scn.deadline_s, err=self.err
             )
+        # per-replica flight-recorder summaries: the postmortems the
+        # run tripped (breaker opens, quarantines, SLO breaches, shed
+        # bursts), replica-tagged so multi-replica timelines stitch
+        flight = []
+        for rep in self.replicas:
+            if rep.recorder is None:
+                continue
+            rep.recorder.flush(timeout=1.0)
+            flight.append({
+                "replica": rep.name,
+                **rep.recorder.snapshot(),
+                "triggers": [
+                    r["trigger"] for r in rep.recorder.records()
+                ],
+            })
         report = build_report(
             scn.to_dict(),
             load,
@@ -872,6 +952,7 @@ class SoakHarness:
                 "events_log": self.events_log,
                 "warmup_seconds": round(warm_s, 1),
                 "provider_fetches_total": self.stub.fetches,
+                "flight_records": flight,
             },
         )
         return report
@@ -894,6 +975,8 @@ class SoakHarness:
                 rep.fleet_plane.stop()
             if rep.rotator is not None:
                 rep.rotator.stop()
+            if rep.recorder is not None:
+                rep.recorder.stop()
         self.stub.stop()
 
 

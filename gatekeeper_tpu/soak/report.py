@@ -366,6 +366,12 @@ def summarize_soak(res: Dict[str, Any]) -> str:
         head["breaker_transitions"] = len(
             res.get("breaker_transitions") or []
         )
+        # trip-triggered postmortems captured across replicas (full
+        # per-replica detail in the artifact's flight_records section)
+        head["flight_records"] = sum(
+            int(fr.get("captured") or 0)
+            for fr in (res.get("flight_records") or [])
+        )
         head["leak_flagged"] = (res.get("leak") or {}).get("flagged")
         head["checks"] = res.get("checks")
     except Exception as e:  # the summary must never kill the artifact

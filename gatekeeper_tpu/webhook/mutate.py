@@ -253,7 +253,9 @@ class MutationHandler:
         self.log = logger if logger is not None else null_logger()
         self.tracer = tracer
 
-    def handle(self, request: Dict[str, Any]) -> AdmissionResponse:
+    def handle(
+        self, request: Dict[str, Any], trace_id: Optional[str] = None
+    ) -> AdmissionResponse:
         from ..obs import start_span
 
         t0 = time.perf_counter()
@@ -261,6 +263,7 @@ class MutationHandler:
         with start_span(
             self.tracer,
             "mutate_handler",
+            trace_id=trace_id,
             resource_kind=kind.get("kind", ""),
             resource_namespace=request.get("namespace", ""),
             resource_name=request.get("name", ""),
@@ -287,6 +290,7 @@ class MutationHandler:
             self.metrics.observe(
                 "mutation_request_duration_seconds",
                 time.perf_counter() - t0,
+                exemplar=getattr(span, "trace_id", None),
                 mutation_status=status,
             )
         return resp

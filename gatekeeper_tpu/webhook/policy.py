@@ -199,12 +199,19 @@ class ValidationHandler:
         self.event_sink = event_sink
         self.emit_admission_events = emit_admission_events
         self.trace_log = trace_log
-        self.denied_log: List[Dict[str, Any]] = []
+        # bounded: soak replicas run with log_denies on for the
+        # trace-id correlation contract, and a 100%-deny scenario must
+        # churn this ring, not grow it for the process lifetime
+        from collections import deque
+
+        self.denied_log: Any = deque(maxlen=4096)
         self.traces: List[str] = []  # captured per-request traces
 
     # -- entry ---------------------------------------------------------------
 
-    def handle(self, request: Dict[str, Any]) -> AdmissionResponse:
+    def handle(
+        self, request: Dict[str, Any], trace_id: Optional[str] = None
+    ) -> AdmissionResponse:
         import time as _time
 
         from ..obs import start_span
@@ -214,6 +221,10 @@ class ValidationHandler:
         with start_span(
             self.tracer,
             "handler",
+            # an ingested W3C traceparent (or UID-derived id) becomes
+            # THE trace id for this request's whole span tree — the
+            # envelope, denial log, and /debug/traces all share it
+            trace_id=trace_id,
             resource_kind=kind.get("kind", ""),
             resource_namespace=request.get("namespace", ""),
             resource_name=request.get("name", ""),
@@ -235,11 +246,14 @@ class ValidationHandler:
             )
             # the webhook stats reporter's surface (request_count +
             # request_duration_seconds tagged by admission_status,
-            # pkg/webhook/stats_reporter.go:34-79)
+            # pkg/webhook/stats_reporter.go:34-79); the sample carries
+            # the request's trace id as an OpenMetrics exemplar so a
+            # p99 bucket names a concrete trace to open
             self.metrics.record("request_count", 1, admission_status=status)
             self.metrics.observe(
                 "request_duration_seconds",
                 _time.perf_counter() - t0,
+                exemplar=getattr(span, "trace_id", None),
                 admission_status=status,
             )
         return resp

@@ -61,18 +61,26 @@ DEFAULT_MAX_QUEUE = 2048
 
 
 def review_envelope(
-    review: Dict[str, Any], request: Dict[str, Any], resp
+    review: Dict[str, Any], request: Dict[str, Any], resp,
+    trace_id: Optional[str] = None,
 ) -> Dict[str, Any]:
     """The one AdmissionReview response envelope, shared by every
     endpoint (admit / admitlabel / mutate): echoes the request's
     apiVersion (falling back to admission/v1) and uid, and carries the
     handler's response dict — including `patchType`/`patch` when the
-    response has one — so the three endpoints can never drift."""
-    return {
+    response has one — so the three endpoints can never drift. With a
+    trace id (inbound `traceparent` or the admission-UID derivation)
+    the envelope echoes it as `traceId`, so the caller can join its
+    admission verdict to `/debug/traces?trace_id=` and the denial log
+    without guessing (docs/observability.md §Trace propagation)."""
+    out = {
         "apiVersion": review.get("apiVersion", "admission.k8s.io/v1"),
         "kind": "AdmissionReview",
         "response": resp.to_dict(uid=request.get("uid")),
     }
+    if trace_id is not None:
+        out["traceId"] = trace_id
+    return out
 
 
 class MicroBatcher:
@@ -109,10 +117,14 @@ class MicroBatcher:
         # degrades ONLY its subset to the host rung, and quarantined
         # devices re-home their partitions onto healthy ones
         partitioner=None,
+        # obs.FlightRecorder: shed bursts (and the default breaker's
+        # OPEN transitions) trip postmortem captures
+        recorder=None,
     ):
         self.client = client
         self.target = target
         self.partitioner = partitioner
+        self.recorder = recorder
         if partitioner is not None and breaker is None:
             # the per-device breaker bank replaces the plane breaker
             breaker = False
@@ -138,7 +150,8 @@ class MicroBatcher:
         self.tracer = tracer
         if breaker is None:
             breaker = CircuitBreaker(
-                plane=self.plane, metrics=metrics, tracer=tracer
+                plane=self.plane, metrics=metrics, tracer=tracer,
+                recorder=recorder,
             )
         self.breaker: Optional[CircuitBreaker] = breaker or None
         # (request, future, span ctx | None, (wall, perf) submit stamp,
@@ -198,6 +211,13 @@ class MicroBatcher:
                 "shed", sub_wall if sub_wall is not None else now, now,
                 parent=ctx, reason=reason, plane=self.plane,
             )
+        if self.recorder is not None:
+            # shed-burst detection: the recorder counts; crossing its
+            # threshold trips ONE postmortem capture for the storm
+            try:
+                self.recorder.note_shed(self.plane)
+            except Exception:
+                pass
         fut.set_exception(exc)
 
     def submit(self, request: Dict[str, Any], span_ctx=None,
@@ -441,7 +461,8 @@ class MicroBatcher:
         def run_one(p, br):
             try:
                 return p, br, client.review_many_subset(
-                    reviews, p.subset, device=p.device
+                    reviews, p.subset, device=p.device,
+                    partition=p.index,
                 ), None
             except Exception as e:
                 return p, br, None, e
@@ -717,9 +738,14 @@ class WebhookServer:
         # 0/None keeps the monolithic dispatch + single plane breaker.
         partitions: Optional[int] = None,
         partition_devices: Optional[int] = None,
+        # obs.FlightRecorder: threaded to the batchers (shed bursts),
+        # the plane breaker, and the partitioner's per-device breakers
+        # so a trip anywhere on this server captures one postmortem
+        recorder=None,
     ):
         self.client = client  # warmup() compiles through it
         self.tracer = tracer
+        self.recorder = recorder
         self.request_timeout = request_timeout
         self.drain_grace_s = drain_grace_s
         self.partitioner = None
@@ -734,6 +760,7 @@ class WebhookServer:
                 plane="validation",
                 metrics=metrics,
                 tracer=tracer,
+                recorder=recorder,
             )
         # graceful-drain state: `draining` flips BEFORE the listener
         # closes (readiness consults it), in-flight HTTP requests are
@@ -750,6 +777,7 @@ class WebhookServer:
             metrics=metrics, tracer=tracer,
             max_queue=max_queue,
             partitioner=self.partitioner,
+            recorder=recorder,
         )
         self.mutate_batcher = None
         self.mutation_handler = None
@@ -825,11 +853,27 @@ class WebhookServer:
                         outer._inflight_cv.notify_all()
 
             def _do_post(self):
+                from ..obs import (
+                    derive_trace_id,
+                    format_traceparent,
+                    parse_traceparent,
+                )
+
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                trace_id = None
                 try:
                     review = json.loads(body)
                     request = review.get("request") or {}
+                    # W3C trace propagation (docs/observability.md):
+                    # an inbound `traceparent` names the request's
+                    # trace; without one the admission UID derives a
+                    # deterministic id — either way the id rides the
+                    # handler's root span, the denial log, the response
+                    # envelope, and /debug/traces?trace_id=
+                    trace_id = parse_traceparent(
+                        self.headers.get("traceparent")
+                    ) or derive_trace_id(request.get("uid"))
                     if self.path == "/v1/admitlabel":
                         resp = outer.label_handler.handle(request)
                     elif self.path == "/v1/mutate":
@@ -839,7 +883,9 @@ class WebhookServer:
                             ).encode()
                             self.send_response(404)
                             raise _Handled()
-                        resp = outer.mutation_handler.handle(request)
+                        resp = outer.mutation_handler.handle(
+                            request, trace_id=trace_id
+                        )
                     elif self.path == "/v1/agent/review":
                         if outer.agent_handler is None:
                             payload = json.dumps(
@@ -847,11 +893,17 @@ class WebhookServer:
                             ).encode()
                             self.send_response(404)
                             raise _Handled()
-                        resp = outer.agent_handler.handle(request)
+                        resp = outer.agent_handler.handle(
+                            request, trace_id=trace_id
+                        )
                     else:
-                        resp = outer.handler.handle(request)
+                        resp = outer.handler.handle(
+                            request, trace_id=trace_id
+                        )
                     payload = json.dumps(
-                        review_envelope(review, request, resp)
+                        review_envelope(
+                            review, request, resp, trace_id=trace_id
+                        )
                     ).encode()
                     self.send_response(200)
                 except _Handled:
@@ -862,6 +914,10 @@ class WebhookServer:
                 try:
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
+                    if trace_id is not None:
+                        self.send_header(
+                            "traceparent", format_traceparent(trace_id)
+                        )
                     self.end_headers()
                     self.wfile.write(payload)
                 except (BrokenPipeError, ConnectionResetError):

@@ -1,0 +1,442 @@
+"""Cost-attribution & flight-recorder plane tests (ISSUE 10).
+
+Covers: the CostAttributor's weighted apportionment and top-K table,
+the driver-seam sums invariant (attributed seconds == measured
+device-execute seconds within 10%), the metrics-registry cardinality
+guard + OpenMetrics exemplars, the W3C traceparent helpers and OTLP
+export, `/debug/costs` / `/debug/flightrecords` over HTTP, and the
+FlightRecorder's trigger/debounce/rate-limit/bounded-retention
+contract.
+"""
+
+import json
+import os
+import re
+import time
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget, TpuDriver
+from gatekeeper_tpu.metrics import MetricsRegistry, serve_metrics
+from gatekeeper_tpu.obs import (
+    CostAttributor,
+    FlightRecorder,
+    Tracer,
+    derive_trace_id,
+    format_traceparent,
+    parse_traceparent,
+)
+
+pytestmark = pytest.mark.obs
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+PRIV_REGO = """package attrpriv
+
+violation[{"msg": msg}] {
+    input.review.object.spec.containers[_].securityContext.privileged
+    msg := "privileged container"
+}
+"""
+
+LABELS_REGO = """package attrlab
+
+violation[{"msg": msg}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("missing: %v", [missing])
+}
+"""
+
+
+def template(kind, rego):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    }
+
+
+def constraint(kind, name, params=None):
+    spec = {"match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}}
+    if params is not None:
+        spec["parameters"] = params
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def review(i):
+    from gatekeeper_tpu.constraint import AugmentedReview
+
+    return AugmentedReview({
+        "uid": f"u{i}",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": "CREATE",
+        "name": f"p{i}",
+        "namespace": "default",
+        "object": {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": f"p{i}", "namespace": "default",
+                         "labels": {}},
+            "spec": {"containers": [{
+                "name": "c", "image": "nginx",
+                "securityContext": {"privileged": bool(i % 3 == 0)},
+            }]},
+        },
+    })
+
+
+# ---------------------------------------------------------------------------
+# attributor model
+
+
+def test_attributor_apportions_by_weight():
+    reg = MetricsRegistry()
+    a = CostAttributor(metrics=reg)
+    a.note_dispatch(
+        [("K1", "a", 3.0), ("K1", "b", 1.0)], 0.4, partition=0
+    )
+    tab = a.table()
+    assert tab["total_device_seconds"] == pytest.approx(0.4)
+    rows = {(r["kind"], r["name"]): r for r in tab["rows"]}
+    assert rows[("K1", "a")]["seconds"] == pytest.approx(0.3)
+    assert rows[("K1", "b")]["seconds"] == pytest.approx(0.1)
+    assert rows[("K1", "a")]["share"] == pytest.approx(0.75)
+    # the Prometheus series carries the same apportionment
+    counters = reg.snapshot()["counters"]
+    key = 'constraint_device_seconds_total{kind="K1",name="a",partition="0"}'
+    assert counters[key] == pytest.approx(0.3)
+
+
+def test_attributor_zero_weights_split_evenly_and_sum():
+    a = CostAttributor()
+    a.note_dispatch([("K", "x", 0.0), ("K", "y", 0.0)], 0.2)
+    tab = a.table()
+    assert tab["rows"][0]["seconds"] == pytest.approx(0.1)
+    # the sums invariant at the model level: apportionment never
+    # creates or destroys time
+    assert sum(r["seconds"] for r in tab["rows"]) == pytest.approx(
+        tab["total_device_seconds"]
+    )
+
+
+def test_attributor_topk_sorted_with_omission_count():
+    a = CostAttributor(replica="rep-0")
+    for i in range(20):
+        a.note_dispatch([(f"K{i}", "c", 1.0)], 0.001 * (i + 1),
+                        partition=i % 3)
+    tab = a.table(5)
+    assert len(tab["rows"]) == 5
+    assert tab["rows_omitted"] == 15
+    assert tab["replica"] == "rep-0"
+    secs = [r["seconds"] for r in tab["rows"]]
+    assert secs == sorted(secs, reverse=True)
+    # costliest first: the last-noted (largest) dispatch leads
+    assert tab["rows"][0]["kind"] == "K19"
+
+
+# ---------------------------------------------------------------------------
+# the driver seam: attributed == measured (the 10% acceptance check)
+
+
+def make_client(driver, n_per_kind=4):
+    cl = Backend(driver).new_client(K8sValidationTarget())
+    cl.add_template(template("AttrPriv", PRIV_REGO))
+    cl.add_template(template("AttrLab", LABELS_REGO))
+    for i in range(n_per_kind):
+        cl.add_constraint(constraint("AttrPriv", f"p{i}"))
+        cl.add_constraint(
+            constraint("AttrLab", f"l{i}", params={"labels": ["owner"]})
+        )
+    return cl
+
+
+def _measured_device_seconds(reg):
+    total = 0.0
+    for key, d in reg.snapshot()["distributions"].items():
+        if key.startswith("driver_phase_seconds") and (
+            'phase="device_dispatch"' in key
+        ):
+            total += float(d["sum"])
+    return total
+
+
+def test_attribution_sums_match_measured_device_seconds():
+    reg = MetricsRegistry()
+    driver = TpuDriver()
+    driver.set_metrics(reg)
+    attributor = CostAttributor(metrics=reg)
+    driver.set_attributor(attributor)
+    cl = make_client(driver)
+    reviews = [review(i) for i in range(32)]
+    cl.warm_review_path(reviews)
+    # monolithic dispatch + two partition-scoped subset dispatches
+    cl.review_many(reviews)
+    keys = driver.constraint_keys(TARGET)
+    half = len(keys) // 2
+    cl.review_many_subset(reviews, frozenset(keys[:half]), partition=0)
+    cl.review_many_subset(reviews, frozenset(keys[half:]), partition=1)
+    measured = _measured_device_seconds(reg)
+    attributed = attributor.snapshot()["total_device_seconds"]
+    assert measured > 0
+    assert abs(attributed - measured) <= 0.10 * measured
+    tab = a_tab = attributor.table(10)
+    assert a_tab["rows"], tab
+    # partition labels distinguish the subset dispatches from the
+    # monolithic one
+    parts = set()
+    for r in tab["rows"]:
+        parts.update(r["partitions"])
+    assert "mono" in parts
+    assert parts & {"0", "1"}
+
+
+def test_static_cost_weights_programs_over_interpreter():
+    assert TpuDriver._static_cost(None) == 1.0
+
+    class _P:
+        signature = ("a", "b", "c")
+        row_features = ("f1",)
+        consts = {}
+
+    assert TpuDriver._static_cost(_P()) == pytest.approx(3 * 2)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry: cardinality guard + exemplars
+
+
+def test_cardinality_guard_caps_family_fanout():
+    reg = MetricsRegistry(max_series_per_family=5)
+    for i in range(12):
+        reg.record("churny_total", 1, name=f"c{i}")
+    counters = reg.snapshot()["counters"]
+    live = [k for k in counters if k.startswith("churny_total")]
+    assert len(live) == 5
+    assert reg.dropped_series() == {"churny_total": 7}
+    drop_key = 'metrics_dropped_series_total{family="churny_total"}'
+    assert counters[drop_key] == 7
+    # existing series keep updating under the cap
+    reg.record("churny_total", 5, name="c0")
+    assert reg.snapshot()["counters"]['churny_total{name="c0"}'] == 6
+    # distributions and gauges are guarded by the same cap
+    for i in range(12):
+        reg.gauge("churny_gauge", i, name=f"g{i}")
+        reg.observe("churny_seconds", 0.01, name=f"d{i}")
+    assert reg.dropped_series()["churny_gauge"] == 7
+    assert reg.dropped_series()["churny_seconds"] == 7
+
+
+def test_exemplar_exposition_parses():
+    reg = MetricsRegistry()
+    reg.observe("request_duration_seconds", 0.004,
+                exemplar="00c0ffee" * 4, admission_status="allow")
+    text = reg.prometheus_text()
+    ex_lines = [ln for ln in text.splitlines() if " # {" in ln]
+    assert ex_lines, text
+    ex_re = re.compile(
+        r'_bucket\{.*\} \d+ # \{trace_id="[0-9a-f]+"\} '
+        r"[0-9.eE+-]+ [0-9.eE+-]+$"
+    )
+    assert any(ex_re.search(ln) for ln in ex_lines), ex_lines
+    # exemplar-free buckets stay plain
+    reg2 = MetricsRegistry()
+    reg2.observe("request_duration_seconds", 0.004,
+                 admission_status="allow")
+    assert " # {" not in reg2.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# traceparent / OTLP
+
+
+def test_traceparent_parse_and_derive():
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    assert parse_traceparent(
+        f"00-{tid}-00f067aa0ba902b7-01"
+    ) == tid
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent(f"00-{'0' * 32}-00f067aa0ba902b7-01") is None
+    assert parse_traceparent(f"00-{tid}-{'0' * 16}-01") is None
+    d1, d2 = derive_trace_id("uid-1"), derive_trace_id("uid-1")
+    assert d1 == d2 and len(d1) == 32
+    assert derive_trace_id("uid-2") != d1
+    assert derive_trace_id(None) is None
+    hdr = format_traceparent(tid)
+    assert parse_traceparent(hdr) == tid
+
+
+def test_otlp_export_shape():
+    tr = Tracer()
+    with tr.start_span("root", k="v") as root:
+        with tr.start_span("child"):
+            pass
+        tid = root.trace_id
+    doc = json.loads(tr.export_otlp())
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(spans) == 2
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["child"]["parentSpanId"] == by_name["root"]["spanId"]
+    for s in spans:
+        assert re.fullmatch(r"[0-9a-f]{32}", s["traceId"])
+        assert re.fullmatch(r"[0-9a-f]{16}", s["spanId"])
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+    # trace_id filtering narrows to one trace; W3C-hex ids pass through
+    doc2 = json.loads(tr.export_otlp(trace_id=tid))
+    assert doc2["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert json.loads(tr.export_otlp(trace_id="missing")) == {
+        "resourceSpans": [{
+            "resource": {"attributes": [{
+                "key": "service.name",
+                "value": {"stringValue": "gatekeeper-tpu"},
+            }]},
+            "scopeSpans": [{
+                "scope": {"name": "gatekeeper_tpu.obs"}, "spans": [],
+            }],
+        }],
+    }
+
+
+def test_debug_endpoints_over_http():
+    tracer = Tracer()
+    with tracer.start_span("op"):
+        pass
+    (trace,) = tracer.recent(1)
+    tid = trace["trace_id"]
+    reg = MetricsRegistry()
+    attributor = CostAttributor(metrics=reg)
+    attributor.note_dispatch([("K", "a", 1.0)], 0.01)
+    recorder = FlightRecorder(
+        tracer=tracer, attributor=attributor,
+        min_interval_s=0.0, debounce_s=0.0,
+    )
+    recorder.trigger("unit_test", detail=1)
+    assert recorder.flush()
+    for _ in range(200):
+        if recorder.records():
+            break
+        time.sleep(0.01)
+    httpd = serve_metrics(
+        reg, port=0, tracer=tracer, attributor=attributor,
+        recorder=recorder,
+    )
+    try:
+        port = httpd.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as r:
+                assert r.headers["Content-Type"] == "application/json"
+                return json.loads(r.read())
+
+        costs = get("/debug/costs")
+        assert costs["rows"][0]["kind"] == "K"
+        fr = get("/debug/flightrecords")
+        assert fr["records"] and fr["records"][0]["trigger"] == "unit_test"
+        by_id = get(f"/debug/traces?trace_id={tid}")
+        assert by_id["traces"][0]["trace_id"] == tid
+        assert get("/debug/traces?trace_id=nope") == {"traces": []}
+        otlp = get("/debug/traces?format=otlp&limit=5")
+        assert "resourceSpans" in otlp
+    finally:
+        httpd.shutdown()
+        recorder.stop()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def test_flightrecorder_capture_contents_and_sources():
+    tracer = Tracer()
+    with tracer.start_span("degraded_subset", plane="validation"):
+        pass
+    attributor = CostAttributor()
+    attributor.note_dispatch([("K", "a", 1.0)], 0.02)
+    rec = FlightRecorder(
+        tracer=tracer, attributor=attributor, replica="r0",
+        min_interval_s=0.0, debounce_s=0.0,
+    )
+    rec.add_source("queue", lambda: {"depth": 7})
+    rec.add_source("broken", lambda: (_ for _ in ()).throw(ValueError("x")))
+    rec.trigger("breaker_open", breaker="device:validation:1",
+                from_state="closed", to_state="open")
+    for _ in range(200):
+        if rec.records():
+            break
+        time.sleep(0.01)
+    (record,) = rec.records()
+    assert record["trigger"] == "breaker_open"
+    assert record["replica"] == "r0"
+    assert record["triggers"][0]["context"]["breaker"] == (
+        "device:validation:1"
+    )
+    assert any(
+        s["name"] == "degraded_subset"
+        for t in record["trace_tail"] for s in t["spans"]
+    )
+    assert record["costs"]["rows"][0]["kind"] == "K"
+    assert record["state"]["queue"] == {"depth": 7}
+    assert "error" in record["state"]["broken"]
+    assert "faults" in record
+    rec.stop()
+
+
+def test_flightrecorder_debounce_coalesces_and_rate_limits():
+    rec = FlightRecorder(min_interval_s=60.0, debounce_s=0.1)
+    for i in range(5):
+        rec.trigger("breaker_open", n=i)
+    for _ in range(300):
+        if rec.captured:
+            break
+        time.sleep(0.01)
+    # one record for the burst (the debounce window coalesced it)
+    assert rec.captured == 1
+    (record,) = rec.records()
+    assert len(record["triggers"]) == 5
+    # a later trigger inside the rate-limit window is suppressed
+    rec.trigger("breaker_open", n=99)
+    rec.flush()
+    time.sleep(0.3)
+    assert rec.captured == 1
+    assert rec.suppressed >= 1
+    rec.stop()
+
+
+def test_flightrecorder_bounded_in_memory_and_on_disk(tmp_path):
+    d = str(tmp_path / "flight")
+    rec = FlightRecorder(
+        dir=d, min_interval_s=0.0, debounce_s=0.0, max_records=16,
+    )
+    for i in range(25):
+        rec.trigger("unit_test", i=i)
+        # serialize: each trigger must land as its own capture
+        for _ in range(300):
+            if rec.captured > i:
+                break
+            time.sleep(0.005)
+    assert rec.captured == 25
+    records = rec.records()
+    assert len(records) == 16  # bounded ring
+    # newest first, oldest pruned
+    assert records[0]["triggers"][0]["context"]["i"] == 24
+    files = [f for f in os.listdir(d) if f.endswith(".json")]
+    assert len(files) == 16  # bounded on disk too
+    with open(os.path.join(d, sorted(files)[-1])) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "unit_test"
+    rec.stop()

@@ -933,7 +933,8 @@ def test_audit_status_write_fault_counted_sweep_survives():
 PART_NAMESPACES = ["ns-a", "ns-b", "ns-c", "ns-d"]
 
 
-def build_partitioned_stack(recovery_clock, failure_threshold=2):
+def build_partitioned_stack(recovery_clock, failure_threshold=2,
+                            recorder=None):
     """4 constraint kinds, each matching exactly one namespace, split
     over a 4-partition plan (sorted identities -> kind i lands in
     partition i on device i): one namespace addresses one fault
@@ -971,6 +972,7 @@ def build_partitioned_stack(recovery_clock, failure_threshold=2):
         cl, TARGET, k=4, metrics=metrics, tracer=tracer,
         failure_threshold=failure_threshold, recovery_seconds=5.0,
         clock=lambda: recovery_clock[0],
+        recorder=recorder,
     )
     batcher = MicroBatcher(
         cl, TARGET, window_ms=1.0, metrics=metrics, tracer=tracer,
@@ -1102,6 +1104,105 @@ def test_partitioned_device_fault_isolates_constraint_subset():
     finally:
         batcher.stop()
         disp.close()
+
+
+def test_device_fault_trips_exactly_one_flight_record():
+    """The flight-recorder chaos e2e (ISSUE 10 acceptance): a device
+    fault that trips `device:validation:1` produces EXACTLY ONE flight
+    record, containing the breaker transition, the quarantined
+    partition's constraint keys, and >= 1 degraded-request trace —
+    retrievable at /debug/flightrecords and bounded at N=16."""
+    import json
+    import urllib.request
+
+    from gatekeeper_tpu.faults import device_point
+    from gatekeeper_tpu.metrics import serve_metrics
+    from gatekeeper_tpu.obs import FlightRecorder
+
+    clock = [0.0]
+    recorder = FlightRecorder(
+        # rate limit far beyond the test window: related triggers
+        # coalesce into ONE record and nothing else can slip in
+        min_interval_s=300.0, debounce_s=0.15, max_records=16,
+    )
+    _, metrics, tracer, disp, batcher, handler = build_partitioned_stack(
+        clock, recorder=recorder
+    )
+    recorder.tracer = tracer
+    recorder.metrics = metrics
+    recorder.add_source("partitions", disp.postmortem)
+    batcher.start()
+    try:
+        # healthy traffic first (plan built, no triggers)
+        for i, ns in enumerate(PART_NAMESPACES):
+            assert not handler.handle(ns_request(i, ns)).allowed
+        assert recorder.records() == []
+
+        # sicken device 1: two ns-b failures trip its breaker to OPEN
+        FAULTS.arm(device_point("driver.device_dispatch", 1),
+                   mode="error")
+        for i in range(2):
+            resp = handler.handle(ns_request(30 + i, "ns-b"))
+            assert not resp.allowed and resp.code == 403
+        assert disp.breaker(1).state == OPEN
+
+        # exactly one record captures (debounce + rate limit)
+        deadline = time.monotonic() + 5.0
+        while not recorder.records() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # traffic AFTER the trip must not mint more records
+        assert not handler.handle(ns_request(40, "ns-b")).allowed
+        recorder.flush()
+        time.sleep(0.2)
+        records = recorder.records()
+        assert len(records) == 1, [r["trigger"] for r in records]
+        record = records[0]
+
+        # (a) the breaker transition
+        assert record["trigger"] == "breaker_open"
+        ctx = record["triggers"][0]["context"]
+        assert ctx["breaker"] == "device:validation:1"
+        assert ctx["from_state"] == CLOSED and ctx["to_state"] == OPEN
+
+        # (b) the quarantined partition's constraint keys
+        part_state = record["state"]["partitions"]
+        assert part_state["quarantined"] == [1]
+        assert part_state["quarantined_constraint_keys"] == [
+            "FaultB/need-owner-ns-b"
+        ]
+
+        # (c) >= 1 degraded-request trace in the tail
+        degraded = [
+            t for t in record["trace_tail"]
+            if any(s["name"] == "degraded_subset" for s in t["spans"])
+        ]
+        assert degraded, [
+            [s["name"] for s in t["spans"]] for t in record["trace_tail"]
+        ]
+
+        # (d) retrievable via /debug/flightrecords, bound advertised
+        httpd = serve_metrics(metrics, port=0, recorder=recorder)
+        try:
+            port = httpd.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/flightrecords", timeout=5
+            ) as r:
+                doc = json.loads(r.read())
+            assert doc["max_records"] == 16
+            assert len(doc["records"]) == 1
+            assert doc["records"][0]["trigger"] == "breaker_open"
+        finally:
+            httpd.shutdown()
+
+        # the flight_records_total series counted the capture
+        assert counter(
+            metrics, "flight_records_total", trigger="breaker_open"
+        ) == 1
+    finally:
+        FAULTS.reset()
+        batcher.stop()
+        disp.close()
+        recorder.stop()
 
 
 def test_partitioned_all_devices_dead_falls_back_to_plane_host_mode():

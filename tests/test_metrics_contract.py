@@ -94,3 +94,82 @@ def test_every_documented_metric_is_emitted():
         "docs/metrics.md documents metrics no longer emitted anywhere "
         f"under gatekeeper_tpu/: {stale}"
     )
+
+
+# exposition-lint grammar: one sample line — name{labels} value, with
+# an optional OpenMetrics exemplar (`# {label="v"} value [ts]`) tail
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+-]+"
+    r'( # \{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"\}'
+    r" -?[0-9.eE+-]+( -?[0-9.eE+-]+)?)?$"
+)
+_EXEMPLAR_RE = re.compile(
+    r' # \{trace_id="[0-9a-zA-Z]+"\} -?[0-9.eE+-]+ -?[0-9.eE+-]+$'
+)
+
+
+def test_exposition_validity_lint():
+    """Exposition lint: a registry exercising every series shape —
+    counters, gauges, histograms (with an exemplar), summaries,
+    min/max companions, a multi-label-set family, and the cardinality
+    guard's drop counter — renders to text with (a) exactly one
+    # HELP and one # TYPE per family, HELP-before-TYPE-before-samples,
+    (b) every sample line matching the exposition grammar, and (c)
+    every exemplar in OpenMetrics syntax on a _bucket line."""
+    from gatekeeper_tpu.metrics import MetricsRegistry
+
+    reg = MetricsRegistry(max_series_per_family=4)
+    reg.describe("request_count", "requests handled")
+    for status in ("allow", "deny"):
+        reg.record("request_count", 2, admission_status=status)
+    reg.gauge("device_breaker_state", 1, plane="validation")
+    reg.observe("request_duration_seconds", 0.004,
+                exemplar="4bf92f3577b34da6a3ce929d0e0e4736",
+                admission_status="allow")
+    reg.observe("request_duration_seconds", 7.5,
+                admission_status="deny")
+    reg.set_buckets("webhook_batch_size", ())
+    reg.observe("webhook_batch_size", 17)  # bucketless summary
+    for i in range(9):  # trips the 4-series cap -> drop counter series
+        reg.record("constraint_device_seconds_total", 0.1,
+                   kind="K", name=f"c{i}", partition="0")
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    assert lines, text
+
+    helps = [ln.split()[2] for ln in lines if ln.startswith("# HELP")]
+    types = [ln.split()[2] for ln in lines if ln.startswith("# TYPE")]
+    assert len(helps) == len(set(helps)), "duplicate # HELP lines"
+    assert len(types) == len(set(types)), "duplicate # TYPE lines"
+    assert set(helps) == set(types)
+
+    seen_meta = set()
+    for ln in lines:
+        if ln.startswith("# HELP"):
+            seen_meta.add(ln.split()[2])
+            continue
+        if ln.startswith("# TYPE"):
+            assert ln.split()[2] in seen_meta, f"TYPE before HELP: {ln}"
+            continue
+        assert _SAMPLE_RE.match(ln), f"unparseable sample line: {ln!r}"
+        family = ln.split("{")[0].split(" ")[0]
+        base = family
+        for suffix in ("_bucket", "_count", "_sum", "_min", "_max"):
+            if family.endswith(suffix):
+                base = family[: -len(suffix)]
+        assert any(
+            m in (family, base) or family.startswith(m)
+            for m in seen_meta
+        ), f"sample before its HELP: {ln!r}"
+        if " # {" in ln:
+            assert "_bucket{" in ln, f"exemplar off a bucket line: {ln!r}"
+            assert _EXEMPLAR_RE.search(ln), f"bad exemplar syntax: {ln!r}"
+
+    # the exemplar actually rendered, and the guard's drop counter too
+    assert any(_EXEMPLAR_RE.search(ln) for ln in lines)
+    assert any(
+        ln.startswith(
+            "gatekeeper_metrics_dropped_series_total"
+        )
+        for ln in lines
+    ), text

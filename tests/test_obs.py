@@ -22,6 +22,8 @@ from gatekeeper_tpu.logs import CapturingLogger
 from gatekeeper_tpu.metrics import MetricsRegistry
 from gatekeeper_tpu.obs import Tracer, span_breakdown, start_span
 
+pytestmark = pytest.mark.obs
+
 TARGET = "admission.k8s.gatekeeper.sh"
 
 REQ_LABELS = """package reqlabels
@@ -265,6 +267,213 @@ def test_handler_span_without_batcher():
     by_name = {s["name"]: s for s in trace["spans"]}
     assert by_name["dispatch"]["attrs"]["route"] == "serial"
     assert handler.denied_log[0]["trace_id"] == trace["trace_id"]
+
+
+def test_traceparent_propagation_end_to_end():
+    """An inbound W3C traceparent names the request's trace: the id
+    rides the handler root span, the response envelope (`traceId` +
+    `traceparent` response header), the denial log record, and the
+    `/debug/traces?trace_id=` lookup on the metrics plane — including
+    the OTLP export form."""
+    from gatekeeper_tpu.metrics import serve_metrics
+    from gatekeeper_tpu.webhook.server import WebhookServer
+
+    tracer = Tracer()
+    reg = MetricsRegistry()
+    log = CapturingLogger()
+    server = WebhookServer(
+        make_client(), TARGET, window_ms=1.0, tracer=tracer,
+        metrics=reg, log_denies=True, logger=log,
+    )
+    server.start()
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    try:
+        body = json.dumps({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": admission_request(),
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/admit",
+            data=body,
+            headers={
+                "Content-Type": "application/json",
+                "traceparent": f"00-{tid}-00f067aa0ba902b7-01",
+            },
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            doc = json.loads(resp.read())
+            hdr = resp.headers.get("traceparent")
+        assert doc["response"]["allowed"] is False
+        # the envelope and response header echo the inbound trace id
+        assert doc["traceId"] == tid
+        assert hdr is not None and tid in hdr
+    finally:
+        server.stop()
+    # the whole span tree carries the inbound id
+    trace = tracer.get(tid)
+    assert trace is not None
+    names = {s["name"] for s in trace["spans"]}
+    assert {"handler", "queue_wait", "dispatch"} <= names
+    # denial log correlation
+    denies = [r for r in log.records if r.get("msg") == "denied admission"]
+    assert denies and denies[0]["trace_id"] == tid
+    assert server.handler.denied_log[0]["trace_id"] == tid
+    # the request_duration histogram carries the trace id as exemplar
+    assert f'trace_id="{tid}"' in reg.prometheus_text()
+    # /debug/traces?trace_id= lookup over HTTP (metrics plane)
+    httpd = serve_metrics(reg, port=0, tracer=tracer)
+    try:
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces?trace_id={tid}",
+            timeout=5,
+        ) as r:
+            assert r.headers["Content-Type"] == "application/json"
+            found = json.loads(r.read())["traces"]
+        assert found and found[0]["trace_id"] == tid
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces?trace_id={tid}"
+            "&format=otlp",
+            timeout=5,
+        ) as r:
+            otlp = json.loads(r.read())
+        spans = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert spans and all(s["traceId"] == tid for s in spans)
+    finally:
+        httpd.shutdown()
+
+
+def test_uid_derived_trace_id_without_traceparent():
+    """No inbound traceparent: the admission UID derives the trace id
+    deterministically, and the envelope still echoes it."""
+    from gatekeeper_tpu.obs import derive_trace_id
+    from gatekeeper_tpu.webhook.server import WebhookServer
+
+    tracer = Tracer()
+    server = WebhookServer(
+        make_client(), TARGET, window_ms=1.0, tracer=tracer,
+    )
+    server.start()
+    try:
+        body = json.dumps({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": admission_request(uid="uid-42"),
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/admit",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            doc = json.loads(resp.read())
+        expect = derive_trace_id("uid-42")
+        assert doc["traceId"] == expect
+        assert tracer.get(expect) is not None
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# partitioned dispatch: cross-thread span parenting
+
+
+def make_partitioned_stack(tracer, k=2):
+    """Client with 2 kinds × 2 constraints behind a PartitionDispatcher
+    — the fault-domain serving shape the trace tree must survive."""
+    from gatekeeper_tpu.parallel.partition import PartitionDispatcher
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    cl = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    cl.add_template(template("ReqLabels", REQ_LABELS))
+    cl.add_template(
+        template("ReqLabelsB", REQ_LABELS.replace("reqlabels", "reqlabelsb"))
+    )
+    cl.add_constraint(
+        constraint("ReqLabels", "need-owner", params={"labels": ["owner"]})
+    )
+    cl.add_constraint(
+        constraint("ReqLabelsB", "need-team", params={"labels": ["team"]})
+    )
+    disp = PartitionDispatcher(
+        cl, TARGET, k=k, failure_threshold=1, recovery_seconds=60.0,
+        tracer=tracer,
+    )
+    batcher = MicroBatcher(
+        cl, TARGET, window_ms=1.0, tracer=tracer, partitioner=disp,
+    )
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=30, tracer=tracer
+    )
+    return cl, disp, batcher, handler
+
+
+def _assert_coherent_tree(trace):
+    """Every span's parent resolves inside the SAME trace — one
+    coherent tree, no orphans pointing at another trace's ids."""
+    ids = {s["span_id"] for s in trace["spans"]}
+    roots = [s for s in trace["spans"] if s["parent_id"] is None]
+    assert len(roots) == 1, trace["spans"]
+    for s in trace["spans"]:
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in ids, s
+
+
+def test_partitioned_dispatch_trace_parenting():
+    """The cross-thread partitioned path: a request whose subset
+    degraded carries a `degraded_subset` span WITH the request's own
+    trace id, and the merged partitioned dispatch still yields one
+    coherent trace tree (single root, all parents internal)."""
+    from gatekeeper_tpu.faults import FAULTS, device_point
+
+    tracer = Tracer()
+    cl, disp, batcher, handler = make_partitioned_stack(tracer)
+    batcher.start()
+    try:
+        # healthy partitioned dispatch first: coherent tree, no
+        # degraded spans
+        resp = handler.handle(admission_request(uid="h1", name="ok"))
+        assert not resp.allowed
+        trace = tracer.recent(1)[0]
+        _assert_coherent_tree(trace)
+        assert not any(
+            s["name"] == "degraded_subset" for s in trace["spans"]
+        )
+        # sicken ONE device: its subset degrades to host, and the
+        # degraded_subset span must land in the REQUEST's trace
+        FAULTS.arm(device_point("driver.device_dispatch", 1), mode="error")
+        resp = handler.handle(admission_request(uid="h2", name="deg"))
+        assert not resp.allowed
+        trace = next(
+            t for t in tracer.recent(5)
+            if any(s["name"] == "handler" for s in t["spans"])
+            and any(
+                s["attrs"].get("resource_name") == "deg"
+                for s in t["spans"] if s["name"] == "handler"
+            )
+        )
+        _assert_coherent_tree(trace)
+        by_name = {s["name"]: s for s in trace["spans"]}
+        deg = by_name.get("degraded_subset")
+        assert deg is not None, [s["name"] for s in trace["spans"]]
+        # the degraded span names the degraded partition(s) and parents
+        # back to this request's handler root
+        assert deg["attrs"]["partitions"], deg
+        root = next(
+            s for s in trace["spans"] if s["parent_id"] is None
+        )
+        assert deg["parent_id"] == root["span_id"]
+        assert by_name["dispatch"]["attrs"]["route"] == "partitioned"
+    finally:
+        FAULTS.reset()
+        batcher.stop()
+        disp.close()
 
 
 # ---------------------------------------------------------------------------

@@ -565,3 +565,95 @@ def test_runner_readyz_reports_draining(tmp_path):
         assert doc["stats"]["draining"] is True
     finally:
         runner.stop()
+
+
+def test_traceparent_propagates_across_two_fleet_replicas():
+    """ISSUE 10 acceptance: a request sent with an inbound
+    `traceparent` header gets that trace id in its admission response
+    envelope, its denial log record, and the replica's
+    `/debug/traces?trace_id=` lookup — on BOTH replicas of a fleet
+    (2-replica soak build, shared FakeCluster gossip plane)."""
+    import urllib.request
+
+    from gatekeeper_tpu.metrics import serve_metrics
+    from gatekeeper_tpu.soak.harness import SoakHarness
+
+    scn = Scenario.from_dict({
+        "name": "traceparent-smoke",
+        "duration_s": 5.0,
+        "rps": 10.0,
+        "deadline_s": 0.5,
+        "window_s": 1.0,
+        "replicas": 2,
+        "tls": False,
+        "constraints": 3,
+        "external_keys": 3,
+    })
+    harness = SoakHarness(scn)
+    try:
+        harness.build()
+        assert len(harness.replicas) == 2
+        for r_idx, rep in enumerate(harness.replicas):
+            tid = f"{r_idx:02x}" + "ab" * 15  # 32-hex, per replica
+            body = json.dumps({
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {
+                    "uid": f"tp-{r_idx}",
+                    "kind": {"group": "", "version": "v1",
+                             "kind": "Pod"},
+                    "operation": "CREATE",
+                    "name": f"tp-pod-{r_idx}",
+                    "namespace": "default",
+                    "userInfo": {"username": "soak"},
+                    "object": {
+                        "apiVersion": "v1",
+                        "kind": "Pod",
+                        "metadata": {"name": f"tp-pod-{r_idx}",
+                                     "namespace": "default"},
+                        "spec": {"containers": [{
+                            "name": "c",
+                            "image": "reg.example/app0",
+                            # privileged => SoakPrivileged denies
+                            "securityContext": {"privileged": True},
+                        }]},
+                    },
+                },
+            }).encode()
+            req = urllib.request.Request(
+                rep.base_url + "/v1/admit",
+                data=body,
+                headers={
+                    "Content-Type": "application/json",
+                    "traceparent": f"00-{tid}-00f067aa0ba902b7-01",
+                },
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                doc = json.loads(resp.read())
+            # denial + envelope echo on THIS replica
+            assert doc["response"]["allowed"] is False
+            assert doc["traceId"] == tid
+            denied = rep.server.handler.denied_log
+            assert denied and denied[-1]["trace_id"] == tid
+            # /debug/traces?trace_id= lookup on the replica's metrics
+            # plane finds the whole span tree under the inbound id
+            httpd = serve_metrics(rep.metrics, port=0,
+                                  tracer=rep.tracer)
+            try:
+                port = httpd.server_address[1]
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/traces"
+                    f"?trace_id={tid}",
+                    timeout=5,
+                ) as r:
+                    traces = json.loads(r.read())["traces"]
+                assert traces and traces[0]["trace_id"] == tid
+                names = {
+                    s["name"] for s in traces[0]["spans"]
+                }
+                assert "handler" in names
+            finally:
+                httpd.shutdown()
+    finally:
+        harness.stop()
