@@ -1203,6 +1203,21 @@ def _device_seconds_total(metrics):
     return total
 
 
+def _dispatch_rows(metrics):
+    """(dispatched, total) constraint-row sums across partitions from
+    the decision plane's pruning-efficiency counters —
+    dispatch_efficiency = dispatched/total is ROADMAP item 1's
+    headline instrument (falling with constraint count = pruning is
+    working)."""
+    dispatched = total = 0.0
+    for key, v in metrics.snapshot()["counters"].items():
+        if key.startswith("dispatch_rows_dispatched_total"):
+            dispatched += float(v)
+        elif key.startswith("dispatch_rows_total"):
+            total += float(v)
+    return dispatched, total
+
+
 def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
                           profile=False, err=sys.stderr):
     """The `--attribution` lane (docs/observability.md §Cost
@@ -1217,7 +1232,7 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
     from gatekeeper_tpu.constraint import TpuDriver
     from gatekeeper_tpu.control.runner import capture_jax_profile
     from gatekeeper_tpu.metrics import MetricsRegistry
-    from gatekeeper_tpu.obs import CostAttributor
+    from gatekeeper_tpu.obs import CostAttributor, DecisionLog, Tracer
     from gatekeeper_tpu.parallel.partition import PartitionDispatcher
     from gatekeeper_tpu.webhook.server import (
         BatchedValidationHandler,
@@ -1226,6 +1241,7 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
 
     out = []
     prof = None
+    overhead = None
     for n_con in rungs:
         metrics = MetricsRegistry()
         driver = TpuDriver()
@@ -1233,21 +1249,46 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
         attributor = CostAttributor(metrics=metrics)
         driver.set_attributor(attributor)
         client = build_attribution_client(driver, n_con)
+        # tracing is always-on in production and the decision plane
+        # joins its dispatch facts by trace id — both ride every
+        # measured rung (the ≤5% p50 overhead budget is measured below
+        # as an off/on phase pair with the tracer on throughout)
+        tracer = Tracer(max_traces=2048)
+        decisions = DecisionLog(metrics=metrics, max_per_s=0)
         disp = PartitionDispatcher(
-            client, TARGET, k=min(k, n_con), metrics=metrics
+            client, TARGET, k=min(k, n_con), metrics=metrics,
+            tracer=tracer,
         )
         batcher = MicroBatcher(
             client, TARGET, window_ms=2.0, metrics=metrics,
-            partitioner=disp,
+            partitioner=disp, decisions=decisions, tracer=tracer,
         )
-        handler = BatchedValidationHandler(batcher, request_timeout=60)
+        handler = BatchedValidationHandler(
+            batcher, request_timeout=60, decision_log=decisions,
+            tracer=tracer,
+        )
         batcher.start()
         try:
             _warm_route(client)
             replay(handler, [make_request(i) for i in range(256)], 64)
             replay(handler, [make_request(i) for i in range(512)], 128)
+            if n_con == max(rungs):
+                # decision-plane overhead at the largest rung: the same
+                # replay with the plane detached, then reattached — the
+                # acceptance budget is ≤5% on p50
+                batcher.decisions = None
+                handler.decision_log = None
+                n_off = max(400, n_requests // 3)
+                r_off = replay(
+                    handler,
+                    [make_request(i) for i in range(n_off)], 128,
+                )
+                batcher.decisions = decisions
+                handler.decision_log = decisions
+                overhead = {"constraints": n_con, "off": r_off}
             attributor.reset()
             dev0 = _device_seconds_total(metrics)
+            rows0 = _dispatch_rows(metrics)
             capture = []
             if profile and n_con == max(rungs):
                 # one XPlane capture riding the measured replay: the
@@ -1266,6 +1307,9 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
                 th.shutdown(wait=False)
             measured = _device_seconds_total(metrics) - dev0
             attributed = attributor.snapshot()["total_device_seconds"]
+            rows1 = _dispatch_rows(metrics)
+            rows_dispatched = rows1[0] - rows0[0]
+            rows_total = rows1[1] - rows0[1]
             top = attributor.top(10)
             sums_ok = bool(
                 measured > 0
@@ -1285,20 +1329,44 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
                     round(attributed / measured, 4) if measured else None
                 ),
                 "sums_ok": sums_ok,
+                # the pruning-efficiency headline (ROADMAP item 1):
+                # constraint-rows dispatched / total over the measured
+                # replay — falling with constraint count is what batch-
+                # aware pruned dispatch will be judged by
+                "rows_dispatched": int(rows_dispatched),
+                "rows_total": int(rows_total),
+                "dispatch_efficiency": (
+                    round(rows_dispatched / rows_total, 4)
+                    if rows_total else None
+                ),
+                "decisions": decisions.snapshot(),
                 "top_costs": top,
             }
+            if overhead is not None and overhead.get(
+                "constraints"
+            ) == n_con and "on" not in overhead:
+                overhead["on"] = {
+                    key: r[key] for key in ("p50_ms", "p99_ms")
+                }
+                p_off = overhead["off"]["p50_ms"]
+                overhead["p50_overhead_frac"] = (
+                    round(r["p50_ms"] / p_off - 1.0, 4) if p_off else None
+                )
+                rung["decision_overhead"] = overhead
             out.append(rung)
             top3 = [f"{t['kind']}/{t['name']}" for t in top[:3]]
             print(
                 f"attribution rung c={n_con}: measured="
                 f"{measured:.4f}s attributed={attributed:.4f}s "
-                f"sums_ok={sums_ok} top={top3}",
+                f"sums_ok={sums_ok} "
+                f"dispatch_efficiency={rung['dispatch_efficiency']} "
+                f"top={top3}",
                 file=err,
             )
         finally:
             batcher.stop()
             disp.close()
-    return {"rungs": out, "profile": prof}
+    return {"rungs": out, "profile": prof, "decision_overhead": overhead}
 
 
 # the reference harness's constraint-count ladder
@@ -1610,10 +1678,13 @@ def run_bridge_bench(n_requests, n_constraints, err=sys.stderr):
 def _summarize(mode, res):
     """One short driver-parseable line with the headline numbers: the
     full JSON line has outgrown capture buffers before (BENCH_r05's
-    parsed: null), so the compact SUMMARY survives truncation."""
-    import json
+    parsed: null), so the compact SUMMARY survives truncation. The
+    schema is the shared per-mode contract in gatekeeper_tpu/summary.py
+    (tests/test_summary_contract.py round-trips every mode through the
+    strict reader)."""
+    from gatekeeper_tpu.summary import REQUIRED_FIELDS, format_summary
 
-    head = {"mode": mode}
+    head = {}
     try:
         if mode == "webhook":
             row = next(
@@ -1640,6 +1711,12 @@ def _summarize(mode, res):
             rungs = res.get("rungs") or []
             head["rungs"] = len(rungs)
             head["sums_ok"] = all(r.get("sums_ok") for r in rungs)
+            # per-rung pruning efficiency (ROADMAP item 1's gauge):
+            # dispatched/total constraint rows at every rung
+            head["dispatch_efficiency"] = {
+                str(r["constraints"]): r.get("dispatch_efficiency")
+                for r in rungs
+            }
             if rungs:
                 last = max(rungs, key=lambda r: r["constraints"])
                 head["constraints"] = last["constraints"]
@@ -1650,9 +1727,22 @@ def _summarize(mode, res):
                     f"{t['kind']}/{t['name']}"
                     for t in (last.get("top_costs") or [])[:10]
                 ]
+            oh = res.get("decision_overhead")
+            if oh:
+                head["decision_overhead_p50_frac"] = oh.get(
+                    "p50_overhead_frac"
+                )
             prof = res.get("profile")
             if prof:
                 head["profile_trace_dir"] = prof.get("trace_dir")
+        elif mode == "mutate":
+            replays = res.get("replays") or []
+            if replays:
+                last = replays[-1]
+                for k in ("p50_ms", "p99_ms", "throughput_rps",
+                          "batch_occupancy"):
+                    if k in last:
+                        head[k] = last[k]
         elif isinstance(res, dict):
             phases = res.get("phases")
             if isinstance(phases, list) and phases:
@@ -1676,7 +1766,12 @@ def _summarize(mode, res):
                     head[k] = res[k]
     except Exception as e:  # the summary must never kill the artifact
         head["error"] = str(e)
-    return "SUMMARY: " + json.dumps(head, default=str)
+    # the contract guarantee: every required headline key is PRESENT
+    # (null when a truncated/failed run could not measure it) — the
+    # strict reader keys on presence, not truthiness
+    for f in REQUIRED_FIELDS.get(mode, ()):
+        head.setdefault(f, None)
+    return format_summary(mode, head)
 
 
 def run_soak_bench(argv, err=sys.stderr):

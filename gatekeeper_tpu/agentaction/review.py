@@ -41,6 +41,10 @@ class AgentReviewHandler:
         tracer=None,
         fail_policy: str = "open",
         request_timeout: float = DEFAULT_REQUEST_TIMEOUT,
+        # obs.DecisionLog: agent reviews record tenant = (agent id,
+        # session) so per-agent "why was my tool call denied" is
+        # answerable at /debug/decisions (docs/observability.md)
+        decision_log=None,
     ):
         from ..logs import null_logger
 
@@ -48,6 +52,7 @@ class AgentReviewHandler:
             raise ValueError(
                 f"fail_policy must be 'open' or 'closed', got {fail_policy!r}"
             )
+        self.decision_log = decision_log
         self.batcher = batcher
         self.mutate_batcher = mutate_batcher
         self.metrics = metrics
@@ -85,19 +90,38 @@ class AgentReviewHandler:
                 ),
                 code=resp.code,
             )
+        status = (
+            "allow" if resp.allowed
+            else ("error" if resp.code >= 500 else "deny")
+        )
+        duration_s = time.perf_counter() - t0
         if self.metrics is not None:
-            status = (
-                "allow" if resp.allowed
-                else ("error" if resp.code >= 500 else "deny")
-            )
             self.metrics.record(
                 "agent_review_count", 1, admission_status=status
             )
             self.metrics.observe(
                 "agent_review_duration_seconds",
-                time.perf_counter() - t0,
+                duration_s,
                 exemplar=getattr(span, "trace_id", None),
                 admission_status=status,
+            )
+        if self.decision_log is not None:
+            self.decision_log.record_decision(
+                "agent",
+                status,
+                code=resp.code,
+                trace_id=getattr(span, "trace_id", None) or trace_id,
+                duration_ms=duration_s * 1e3,
+                tenant={
+                    "agent": str(request.get("agent", "")),
+                    "session": str(request.get("session", "")),
+                },
+                message=resp.message if not resp.allowed else "",
+                deadline_slack_ms=(
+                    (self.request_timeout - duration_s) * 1e3
+                ),
+                tool=str(request.get("tool", "")),
+                patch_ops=len(resp.patch or []),
             )
         return resp
 
@@ -211,6 +235,7 @@ def make_agent_plane(
     fail_policy: str = "open",
     request_timeout: float = DEFAULT_REQUEST_TIMEOUT,
     max_queue=None,
+    decision_log=None,
 ):
     """Wire the agent serving plane over an already-registered agent
     target: (review MicroBatcher, optional MutateBatcher,
@@ -227,6 +252,7 @@ def make_agent_plane(
         metrics=metrics,
         tracer=tracer,
         max_queue=max_queue if max_queue is not None else DEFAULT_MAX_QUEUE,
+        decisions=decision_log,
     )
     mutate_batcher = None
     if mutation_system is not None:
@@ -236,6 +262,7 @@ def make_agent_plane(
             metrics=metrics,
             tracer=tracer,
             max_queue=max_queue if max_queue is not None else DEFAULT_MAX_QUEUE,
+            decisions=decision_log,
         )
     handler = AgentReviewHandler(
         batcher,
@@ -245,6 +272,7 @@ def make_agent_plane(
         logger=logger,
         fail_policy=fail_policy,
         request_timeout=request_timeout,
+        decision_log=decision_log,
     )
     return batcher, mutate_batcher, handler
 

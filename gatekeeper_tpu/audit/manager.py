@@ -132,9 +132,16 @@ class AuditManager:
         # ingested state, not an empty cache — the warm sweep is what
         # closes the first-sweep compile cliff (VERDICT r3 #7)
         wait_for: Optional[Callable[[float], bool]] = None,
+        # obs.DecisionLog: each audited violation leaves one decision
+        # record (plane="audit") joined to the sweep's trace id, so the
+        # decision stream covers BOTH admission-time and at-rest
+        # verdicts (docs/observability.md §Decision log). The log's
+        # rate gate bounds a million-violation sweep.
+        decision_log=None,
     ):
         from ..logs import null_logger
 
+        self.decision_log = decision_log
         self.log = logger if logger is not None else null_logger()
         # obs.Tracer: each sweep is one trace (audit_sweep root with
         # per-phase children — dispatch/list, aggregate, status_write)
@@ -262,6 +269,30 @@ class AuditManager:
                 )
             res_l = r.resource if isinstance(r.resource, dict) else {}
             meta_l = res_l.get("metadata") or {}
+            if self.decision_log is not None:
+                # per-violation decision record, joined to the sweep's
+                # trace id; rate-gated + ring-bounded by the log itself
+                self.decision_log.record_decision(
+                    "audit",
+                    "deny" if ea == "deny" else "dryrun",
+                    code=200,
+                    trace_id=getattr(root, "trace_id", None),
+                    tenant={"namespace": meta_l.get("namespace", "")},
+                    violations=[{
+                        "constraint_kind": ckind,
+                        "constraint_name": cname,
+                        "action": ea,
+                        "msg": truncate_message(
+                            r.msg or "", self.msg_size
+                        ),
+                    }],
+                    route="audit",
+                    resource={
+                        "kind": res_l.get("kind", ""),
+                        "name": meta_l.get("name", ""),
+                    },
+                    audit_id=timestamp,
+                )
             # logViolation (manager.go:668-682)
             log.info(
                 truncate_message(r.msg or "", self.msg_size),

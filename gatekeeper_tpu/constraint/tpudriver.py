@@ -1757,11 +1757,14 @@ class TpuDriver(RegoDriver):
             n_host = 0
             n_interp_render = 0
             n_pruned = 0
+            n_cache_hits = 0
             frozen: Dict[int, Any] = {}  # review idx -> frozen review
             for n_i, c_i in pairs:
                 out = None
                 if render_cache is not None:
                     out = render_cache.get((n_i, c_i))
+                    if out is not None:
+                        n_cache_hits += 1
                 if out is None:
                     out = host_rendered.get((n_i, c_i))
                     if out is not None:
@@ -1816,6 +1819,9 @@ class TpuDriver(RegoDriver):
                 "host_rendered_pairs": n_host,
                 "interp_rendered_pairs": n_interp_render,
                 "pruned_renders": n_pruned,
+                # violating pairs answered straight from the render
+                # cache (the decision log's per-request cache fact)
+                "render_cache_hits": n_cache_hits,
                 "render_errors": self._render_errors,
                 "render_cache_evictions": self._render_cache_evictions,
                 "hot_redispatches": self._hot_redispatches,

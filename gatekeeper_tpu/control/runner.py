@@ -124,7 +124,7 @@ class Runner:
         drain_grace_s: float = 0.0,
     ):
         from ..logs import null_logger
-        from ..obs import CostAttributor, FlightRecorder, Tracer
+        from ..obs import CostAttributor, DecisionLog, FlightRecorder, Tracer
 
         self.tracer = tracer if tracer is not None else Tracer()
 
@@ -158,10 +158,15 @@ class Runner:
         set_a = getattr(driver, "set_attributor", None)
         if set_a is not None:
             set_a(self.attributor)
+        # per-admission decision log (docs/observability.md §Decision
+        # log): every plane's "why" records, served at /debug/decisions
+        # and cross-linked into flight records by id + trace id
+        self.decisions = DecisionLog(metrics=metrics, replica=pod_name)
         self.recorder = FlightRecorder(
             tracer=self.tracer,
             attributor=self.attributor,
             metrics=metrics,
+            decisions=self.decisions,
             replica=pod_name,
         )
         self.excluder = Excluder()
@@ -494,6 +499,7 @@ class Runner:
                 drain_grace_s=self.drain_grace_s,
                 partitions=self.partitions or None,
                 recorder=self.recorder,
+                decision_log=self.decisions,
             )
             # postmortem state sources: what a flight record snapshots
             # alongside the trace tail / cost table / fault points
@@ -565,6 +571,7 @@ class Runner:
                 logger=self.log,
                 tracer=self.tracer,
                 wait_for=self._wait_ingested,
+                decision_log=self.decisions,
             )
             self.audit.start()
 
@@ -919,6 +926,7 @@ class Runner:
                     stats["obs"] = {
                         "costs": runner.attributor.snapshot(),
                         "flightrecords": runner.recorder.snapshot(),
+                        "decisions": runner.decisions.snapshot(),
                     }
                     payload = json.dumps(
                         {"ready": ok, "stats": stats}
@@ -949,6 +957,16 @@ class Runner:
                     # trip-triggered postmortem captures, newest first
                     # (docs/observability.md §Flight recorder)
                     payload = runner.recorder.export_json().encode()
+                    self.send_response(200)
+                elif self.path.split("?")[0] == "/debug/decisions":
+                    # per-admission "why" records — ?trace_id=/
+                    # ?verdict=/?plane=/?limit=/?format=ndjson
+                    # (docs/observability.md §Decision log)
+                    from ..metrics.registry import export_decisions
+
+                    payload = export_decisions(
+                        runner.decisions, self.path
+                    ).encode()
                     self.send_response(200)
                 elif self.path == "/healthz":
                     payload = b'{"ok": true}'

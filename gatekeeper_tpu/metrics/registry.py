@@ -408,6 +408,7 @@ def serve_metrics(
     tracer=None,
     attributor=None,
     recorder=None,
+    decisions=None,
 ) -> ThreadingHTTPServer:
     """Serve /metrics (Prometheus text) on a background thread; returns
     the server (server_address[1] carries the bound port). The reference
@@ -415,8 +416,9 @@ def serve_metrics(
     0.0.0.0 so Prometheus can scrape the pod IP (run.py wires this).
     With a tracer, /debug/traces serves the trace ring (?trace_id= /
     ?limit= / ?format=otlp — docs/observability.md); an attributor adds
-    /debug/costs (the top-K cost table) and a flight recorder adds
-    /debug/flightrecords — the same debug trio the health plane serves."""
+    /debug/costs (the top-K cost table), a flight recorder adds
+    /debug/flightrecords, and a decision log adds /debug/decisions —
+    the same debug surface the health plane serves."""
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802
@@ -435,6 +437,13 @@ def serve_metrics(
             elif recorder is not None and route == "/debug/flightrecords":
                 payload = recorder.export_json().encode()
                 ctype = "application/json"
+            elif decisions is not None and route == "/debug/decisions":
+                payload = export_decisions(decisions, self.path).encode()
+                ctype = (
+                    "application/x-ndjson"
+                    if "format=ndjson" in self.path
+                    else "application/json"
+                )
             else:
                 payload = b'{"error": "not found"}'
                 self.send_response(404)
@@ -481,6 +490,29 @@ def _debug_costs_k(path: str) -> Optional[int]:
     except (ValueError, TypeError):
         k = 10
     return None if k <= 0 else min(k, 10_000)
+
+
+def export_decisions(decisions, path: str) -> str:
+    """The one /debug/decisions renderer both HTTP planes (health +
+    metrics) share: ?trace_id= / ?verdict= / ?plane= filter,
+    ?limit=/?n= bounds the count, ?format=ndjson switches to
+    one-record-per-line export (docs/observability.md §Decision log)."""
+    from urllib.parse import parse_qs, urlparse
+
+    q = parse_qs(urlparse(path).query)
+
+    def _one(name):
+        return (q.get(name) or [None])[0] or None
+
+    query = {
+        "trace_id": _one("trace_id"),
+        "verdict": _one("verdict"),
+        "plane": _one("plane"),
+        "limit": _traces_n(path),
+    }
+    if (_one("format") or "").lower() == "ndjson":
+        return decisions.export_ndjson(**query)
+    return decisions.export_json(**query)
 
 
 def export_traces(tracer, path: str) -> str:

@@ -1,9 +1,15 @@
 """Observability: in-process tracing (spans, ring retention, JSON +
 OTLP export, W3C traceparent propagation), per-constraint device-time
-cost attribution, and the trip-triggered flight recorder. See
-docs/observability.md for the span taxonomy and wiring map."""
+cost attribution, the trip-triggered flight recorder, and the
+per-admission decision log. See docs/observability.md for the span
+taxonomy and wiring map."""
 
 from .attribution import MONO_PARTITION, CostAttributor
+from .decisionlog import (
+    DECISION_SCHEMA_FIELDS,
+    DecisionLog,
+    check_decision_schema,
+)
 from .flightrecorder import FlightRecorder
 from .tracer import (
     NOOP_SPAN,
@@ -19,9 +25,12 @@ from .tracer import (
 
 __all__ = [
     "NOOP_SPAN",
+    "DECISION_SCHEMA_FIELDS",
     "MONO_PARTITION",
     "CostAttributor",
+    "DecisionLog",
     "FlightRecorder",
+    "check_decision_schema",
     "Span",
     "SpanContext",
     "Tracer",

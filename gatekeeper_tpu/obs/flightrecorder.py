@@ -59,6 +59,12 @@ class FlightRecorder:
         tracer=None,
         attributor=None,
         metrics=None,
+        # obs.DecisionLog: a capture embeds the trigger window's
+        # failed/degraded decision ids + trace ids, so a postmortem
+        # names the exact requests behind the trip and each is
+        # retrievable at /debug/decisions?trace_id= (the decision ↔
+        # flight cross-link, docs/observability.md §Decision log)
+        decisions=None,
         replica: Optional[str] = None,
         max_records: int = DEFAULT_MAX_RECORDS,
         dir: Optional[str] = None,
@@ -80,6 +86,7 @@ class FlightRecorder:
         self.tracer = tracer
         self.attributor = attributor
         self.metrics = metrics
+        self.decisions = decisions
         self.replica = replica
         self.max_records = max(1, int(max_records))
         self.dir = dir if dir is not None else os.environ.get(
@@ -217,6 +224,26 @@ class FlightRecorder:
                 record["costs"] = self.attributor.table(self.top_k_costs)
             except Exception as e:
                 record["costs_error"] = str(e)
+        if self.decisions is not None:
+            # the trigger window's failed/degraded decisions: ids +
+            # trace ids only (the full records stay in the decision
+            # ring — one source of truth, joined by id/trace_id)
+            try:
+                window = self.decisions.recent_errors(
+                    window_s=max(self.min_interval_s * 6, 30.0)
+                )
+                record["decisions"] = [
+                    {
+                        "id": d.get("id"),
+                        "trace_id": d.get("trace_id"),
+                        "plane": d.get("plane"),
+                        "verdict": d.get("verdict"),
+                        "route": d.get("route"),
+                    }
+                    for d in window
+                ]
+            except Exception as e:
+                record["decisions_error"] = str(e)
         try:
             from ..faults import FAULTS
 

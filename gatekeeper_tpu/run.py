@@ -194,10 +194,13 @@ def main(argv=None) -> int:
                 runner.metrics,
                 port=args.prometheus_port,
                 bind_addr="0.0.0.0",
-                # /debug/traces rides the metrics plane too (the health
-                # server serves it as well; either port works for an
-                # operator with port-forward access)
+                # the debug surface rides the metrics plane too (the
+                # health server serves the same routes; either port
+                # works for an operator with port-forward access)
                 tracer=runner.tracer,
+                attributor=runner.attributor,
+                recorder=runner.recorder,
+                decisions=runner.decisions,
             )
             log.info(
                 "metrics serving", prometheus_port=args.prometheus_port

@@ -223,6 +223,7 @@ class _Replica:
         self.partitioner = None  # device fault domains (partitions > 0)
         self.attributor = None  # per-constraint device-time accounting
         self.recorder = None  # trip-triggered postmortem capture
+        self.decisions = None  # per-admission decision log
 
     @property
     def base_url(self) -> str:
@@ -295,7 +296,7 @@ class SoakHarness:
         from ..externaldata import ExternalDataSystem
         from ..metrics import MetricsRegistry
         from ..mutation import MutationSystem
-        from ..obs import CostAttributor, FlightRecorder, Tracer
+        from ..obs import CostAttributor, DecisionLog, FlightRecorder, Tracer
         from ..webhook.server import WebhookServer
 
         scn = self.scenario
@@ -311,10 +312,15 @@ class SoakHarness:
         # records and cost tables (docs/observability.md)
         rep.attributor = CostAttributor(metrics=rep.metrics, replica=name)
         rep.driver.set_attributor(rep.attributor)
+        # replica-tagged decision log: per-window decision-loss and
+        # route mix ride the sampler; a small ring keeps the leak
+        # series honest (warmup saturates it before measurement)
+        rep.decisions = DecisionLog(metrics=rep.metrics, replica=name)
         rep.recorder = FlightRecorder(
             tracer=rep.tracer,
             attributor=rep.attributor,
             metrics=rep.metrics,
+            decisions=rep.decisions,
             replica=name,
         )
         rep.client = Backend(rep.driver).new_client(
@@ -404,6 +410,7 @@ class SoakHarness:
             # propagation acceptance reads them)
             log_denies=True,
             recorder=rep.recorder,
+            decision_log=rep.decisions,
         )
         rep.recorder.add_source(
             "webhook", lambda rep=rep: {
@@ -731,6 +738,8 @@ class SoakHarness:
         shed = failures = cache_entries = cache_evictions = 0
         trace_ring = metrics_series = render_cache = 0
         cert_gen = metrics_dropped = 0
+        dec_recorded = dec_dropped = dec_sampled = dec_ring = 0
+        dec_routes: Dict[str, int] = {}
         for rep in self.replicas:
             for b in (
                 rep.server.batcher,
@@ -756,6 +765,19 @@ class SoakHarness:
                 render_cache += size_fn()
             if rep.rotator is not None:
                 cert_gen = max(cert_gen, rep.rotator.cert_generation)
+            if rep.decisions is not None:
+                # decision-plane health: recorded vs lost (rate-gated
+                # drops + denial-log drops = "decision loss") and the
+                # cumulative route mix, diffed per window below
+                dsnap = rep.decisions.snapshot()
+                dec_recorded += dsnap["recorded"]
+                dec_dropped += (
+                    dsnap["dropped"] + dsnap["denial_log_dropped"]
+                )
+                dec_sampled += dsnap["sampled_out"]
+                dec_ring += dsnap["retained"]
+                for route, n in dsnap["routes"].items():
+                    dec_routes[route] = dec_routes.get(route, 0) + n
         return {
             "shed_cum": shed,
             "batch_failures_cum": failures,
@@ -769,6 +791,11 @@ class SoakHarness:
             "render_cache": render_cache,
             "rss_kb": self._rss_kb(),
             "cert_generation": cert_gen,
+            "decisions_cum": dec_recorded,
+            "decisions_dropped_cum": dec_dropped,
+            "decisions_sampled_out_cum": dec_sampled,
+            "decision_ring": dec_ring,
+            "decision_routes_cum": dec_routes,
         }
 
     def _sampler_loop(self) -> None:
@@ -801,6 +828,25 @@ class SoakHarness:
                 "render_cache": cur["render_cache"],
                 "rss_kb": cur["rss_kb"],
                 "cert_generation": cur["cert_generation"],
+                # decision-plane per-window view: records kept vs lost
+                # (rate-gate + denial-log drops), the bounded-ring leak
+                # series, and the route mix this window served
+                "decisions": (
+                    cur["decisions_cum"] - prev["decisions_cum"]
+                ),
+                "decisions_dropped": (
+                    cur["decisions_dropped_cum"]
+                    - prev["decisions_dropped_cum"]
+                ),
+                "decisions_sampled_out": (
+                    cur["decisions_sampled_out_cum"]
+                    - prev["decisions_sampled_out_cum"]
+                ),
+                "decision_ring": cur["decision_ring"],
+                "decision_routes": {
+                    route: n - prev["decision_routes_cum"].get(route, 0)
+                    for route, n in cur["decision_routes_cum"].items()
+                },
             })
             prev = cur
             # per-window SLO-breach detector: a window whose failure

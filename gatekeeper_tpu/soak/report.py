@@ -172,6 +172,7 @@ def leak_report(
         "trace_ring": 0.10,
         "metrics_series": 0.10,
         "render_cache": 0.10,
+        "decision_ring": 0.10,  # bounded by construction; proven here
     }
     tol.update(tolerances or {})
     steady = [
@@ -349,9 +350,12 @@ def check_soak_schema(doc: Dict[str, Any]) -> List[str]:
 
 
 def summarize_soak(res: Dict[str, Any]) -> str:
-    """The compact driver-parseable line (the bench SUMMARY contract):
-    headline SLO/shed/leak numbers that survive a truncated capture."""
-    head: Dict[str, Any] = {"mode": "soak"}
+    """The compact driver-parseable line (the bench SUMMARY contract,
+    gatekeeper_tpu/summary.py): headline SLO/shed/leak numbers that
+    survive a truncated capture."""
+    from ..summary import format_summary
+
+    head: Dict[str, Any] = {}
     try:
         scn = res.get("scenario") or {}
         head["scenario"] = scn.get("name")
@@ -376,19 +380,14 @@ def summarize_soak(res: Dict[str, Any]) -> str:
         head["checks"] = res.get("checks")
     except Exception as e:  # the summary must never kill the artifact
         head["error"] = str(e)
-    return "SUMMARY: " + json.dumps(head, default=str)
+    return format_summary("soak", head)
 
 
 def parse_summary_line(line: str) -> Dict[str, Any]:
-    """Round-trip reader for the SUMMARY line (the schema test's other
-    half). Raises on anything that is not a soak summary."""
-    prefix = "SUMMARY: "
-    if not line.startswith(prefix):
-        raise ValueError(f"not a SUMMARY line: {line[:40]!r}")
-    doc = json.loads(line[len(prefix):])
-    if doc.get("mode") != "soak":
-        raise ValueError(f"not a soak summary: mode={doc.get('mode')!r}")
-    for f in ("slo_attainment", "shed_rate", "leak_flagged"):
-        if f not in doc:
-            raise ValueError(f"soak summary missing {f!r}")
-    return doc
+    """Round-trip reader for the soak SUMMARY line — now the soak
+    instance of the shared per-mode schema contract
+    (gatekeeper_tpu/summary.py enforces EVERY bench lane the same
+    way). Raises on anything that is not a valid soak summary."""
+    from ..summary import parse_summary_line as _parse
+
+    return _parse(line, mode="soak")

@@ -66,6 +66,7 @@ class MutateBatcher(MicroBatcher):
         tracer=None,
         max_queue: Optional[int] = DEFAULT_MAX_QUEUE,
         breaker=None,
+        decisions=None,
     ):
         super().__init__(
             client=None,
@@ -77,6 +78,7 @@ class MutateBatcher(MicroBatcher):
             tracer=tracer,
             max_queue=max_queue,
             breaker=breaker,
+            decisions=decisions,
         )
         self.system = system
 
@@ -142,6 +144,7 @@ class MutateBatcher(MicroBatcher):
                         ctx, sub_wall, wall0, wall0, 0.0, 0.0, 0.0,
                         len(batch), 0, "unavailable",
                     )
+                self._note_decisions(batch, "unavailable")
                 return
         screen_s = time.perf_counter() - t_scr
 
@@ -194,6 +197,30 @@ class MutateBatcher(MicroBatcher):
                 ctx, sub_wall, wall0, wall_scr_end, screen_s,
                 apply_s, render_s, len(batch), len(selected), route,
             )
+            if self.decisions is not None:
+                tid = getattr(ctx, "trace_id", None)
+                if tid is not None:
+                    # the mutate plane's "why": which route screened
+                    # the batch, how many mutators matched, and the
+                    # fixpoint iteration count (a 15-iteration record
+                    # is one churn away from a divergence 500)
+                    self.decisions.note_dispatch(
+                        tid,
+                        route={
+                            "batched": "fused",
+                            "fallback": "host",
+                        }.get(route, route),
+                        mutators_matched=len(selected),
+                        fixpoint_iterations=iters,
+                        batch_size=len(batch),
+                    )
+        if muts:
+            # mutation-plane pruning series: every screened (mutator ×
+            # request) row is dispatched today — the same instrument
+            # item 1's pruned dispatch will move for validation
+            self._note_rows(
+                "mono", len(muts) * len(batch), len(muts) * len(batch)
+            )
 
     def _record_mutate_spans(
         self, ctx, sub_wall, wall0, wall_scr_end, screen_s,
@@ -238,6 +265,8 @@ class MutationHandler:
         # down) gets. Convergence failures stay 500 regardless — an
         # unconverged object is NEVER admitted.
         fail_policy: str = "open",
+        # obs.DecisionLog (docs/observability.md §Decision log)
+        decision_log=None,
     ):
         from ..logs import null_logger
 
@@ -246,6 +275,7 @@ class MutationHandler:
                 f"fail_policy must be 'open' or 'closed', got {fail_policy!r}"
             )
         self.fail_policy = fail_policy
+        self.decision_log = decision_log
         self.batcher = batcher
         self.excluder = excluder
         self.metrics = metrics
@@ -278,20 +308,41 @@ class MutationHandler:
                 ),
                 code=resp.code,
             )
+        status = (
+            "error"
+            if not resp.allowed
+            else ("mutated" if resp.patch else "unchanged")
+        )
+        duration_s = time.perf_counter() - t0
         if self.metrics is not None:
-            status = (
-                "error"
-                if not resp.allowed
-                else ("mutated" if resp.patch else "unchanged")
-            )
             self.metrics.record(
                 "mutation_request_count", 1, mutation_status=status
             )
             self.metrics.observe(
                 "mutation_request_duration_seconds",
-                time.perf_counter() - t0,
+                duration_s,
                 exemplar=getattr(span, "trace_id", None),
                 mutation_status=status,
+            )
+        if self.decision_log is not None:
+            self.decision_log.record_decision(
+                "mutation",
+                "allow" if resp.allowed else "error",
+                code=resp.code,
+                trace_id=getattr(span, "trace_id", None) or trace_id,
+                duration_ms=duration_s * 1e3,
+                tenant={
+                    "namespace": request.get("namespace", ""),
+                    "username": (request.get("userInfo") or {}).get(
+                        "username", ""
+                    ),
+                },
+                message=resp.message if not resp.allowed else "",
+                deadline_slack_ms=(
+                    (self.request_timeout - duration_s) * 1e3
+                ),
+                mutation_status=status,
+                patch_ops=len(resp.patch or []),
             )
         return resp
 

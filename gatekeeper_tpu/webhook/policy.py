@@ -168,6 +168,11 @@ class ValidationHandler:
         # audit is the backstop), "closed" denies with a 503. Evaluation
         # ERRORS (a poisoned request) remain 500s regardless.
         fail_policy: str = "open",
+        # obs.DecisionLog: every handled request leaves one "why"
+        # record (verdict + violations + dispatch route/rows facts the
+        # batcher stashed under the trace id), head+error-sampled and
+        # rate-gated (docs/observability.md §Decision log)
+        decision_log=None,
     ):
         from ..logs import null_logger
 
@@ -176,6 +181,7 @@ class ValidationHandler:
                 f"fail_policy must be 'open' or 'closed', got {fail_policy!r}"
             )
         self.fail_policy = fail_policy
+        self.decision_log = decision_log
         self.client = client
         from ..constraint.handler import handler_for
 
@@ -231,7 +237,8 @@ class ValidationHandler:
             operation=request.get("operation", ""),
             username=(request.get("userInfo") or {}).get("username", ""),
         ) as span:
-            resp = self._handle(request, span)
+            decision: Dict[str, Any] = {}
+            resp = self._handle(request, span, decision)
             span.set_attr(
                 admission_status=(
                     "allow" if resp.allowed
@@ -239,11 +246,12 @@ class ValidationHandler:
                 ),
                 code=resp.code,
             )
+        status = (
+            "allow" if resp.allowed
+            else ("error" if resp.code >= 500 else "deny")
+        )
+        duration_s = _time.perf_counter() - t0
         if self.metrics is not None:
-            status = (
-                "allow" if resp.allowed
-                else ("error" if resp.code >= 500 else "deny")
-            )
             # the webhook stats reporter's surface (request_count +
             # request_duration_seconds tagged by admission_status,
             # pkg/webhook/stats_reporter.go:34-79); the sample carries
@@ -252,19 +260,74 @@ class ValidationHandler:
             self.metrics.record("request_count", 1, admission_status=status)
             self.metrics.observe(
                 "request_duration_seconds",
-                _time.perf_counter() - t0,
+                duration_s,
                 exemplar=getattr(span, "trace_id", None),
                 admission_status=status,
             )
+        self._record_decision(
+            request, resp, status, duration_s,
+            getattr(span, "trace_id", None) or trace_id, decision,
+        )
         return resp
 
-    def _handle(self, request: Dict[str, Any], span=None) -> AdmissionResponse:
+    def _record_decision(
+        self,
+        request: Dict[str, Any],
+        resp: "AdmissionResponse",
+        status: str,
+        duration_s: float,
+        trace_id: Optional[str],
+        decision: Dict[str, Any],
+        plane: str = "validation",
+    ) -> None:
+        """One per-admission "why" record: verdict + violations +
+        whatever dispatch facts the batch worker stashed under the
+        trace id (route, partitions dispatched vs mask-skipped,
+        rows_dispatched/rows_total, fetch/cache counts). A shed or
+        unevaluable request records its typed reason as the verdict so
+        overload is first-class in the decision stream."""
+        if self.decision_log is None:
+            return
+        verdict = decision.pop("verdict", None) or status
+        timeout = getattr(self, "request_timeout", None)
+        slack_ms = (
+            (timeout - duration_s) * 1e3 if timeout is not None else None
+        )
+        self.decision_log.record_decision(
+            plane,
+            verdict,
+            code=resp.code,
+            trace_id=trace_id,
+            duration_ms=duration_s * 1e3,
+            tenant={
+                "namespace": request.get("namespace", ""),
+                "username": (request.get("userInfo") or {}).get(
+                    "username", ""
+                ),
+            },
+            violations=decision.pop("violations", []),
+            message=resp.message if not resp.allowed else "",
+            deadline_slack_ms=slack_ms,
+            operation=request.get("operation", ""),
+            resource={
+                "kind": (request.get("kind") or {}).get("kind", ""),
+                "name": request.get("name", ""),
+            },
+            **decision,
+        )
+
+    def _handle(
+        self, request: Dict[str, Any], span=None, decision=None
+    ) -> AdmissionResponse:
         from ..obs import NOOP_SPAN
 
         if span is None:
             span = NOOP_SPAN
+        if decision is None:
+            decision = {}
         user = (request.get("userInfo") or {}).get("username", "")
         if user == SERVICE_ACCOUNT:
+            decision["reason"] = "service_account"
             return AdmissionResponse(True, "Gatekeeper does not self-manage")
 
         request = dict(request)
@@ -288,6 +351,7 @@ class ValidationHandler:
             request, self.excluder, PROCESS_WEBHOOK
         )
         if exempt_reason is not None:
+            decision["reason"] = "exempt"
             return AdmissionResponse(True, exempt_reason)
 
         trace_enabled = dump = False
@@ -296,13 +360,24 @@ class ValidationHandler:
         try:
             results = self._review(request, tracing=trace_enabled, span=span)
         except AdmissionUnavailable as e:
+            # the typed not-evaluated verdicts (shed / deadline /
+            # degraded / timeout) are first-class in the decision
+            # stream — an overload story must be reconstructible from
+            # the records alone
+            decision["verdict"] = (
+                "shed" if e.reason in ("queue_full", "deadline")
+                else "unavailable"
+            )
+            decision["reason"] = e.reason
             return self._unavailable_response(e, span)
         except Exception as e:
             return AdmissionResponse(False, str(e), code=500)
         if dump:
             self._emit_trace(self.client.dump())
 
-        msgs = self._deny_messages(results, request, trace_id=span.trace_id)
+        msgs = self._deny_messages(
+            results, request, trace_id=span.trace_id, decision=decision
+        )
         if msgs:
             return AdmissionResponse(False, "\n".join(msgs), code=403)
         return AdmissionResponse(True, "")
@@ -345,22 +420,42 @@ class ValidationHandler:
         results: List[Any],
         request: Dict[str, Any],
         trace_id: Optional[str] = None,
+        decision: Optional[Dict[str, Any]] = None,
     ) -> List[str]:
         """getDenyMessages (:224-282): deny messages are
         '[denied by <constraint>] <msg>'; dryrun results are recorded
         but never deny. Every denial record carries the request's
-        trace_id so /debug/traces explains the latency behind it."""
+        trace_id so /debug/traces explains the latency behind it, and
+        the violated constraint set lands in the decision record."""
         log = (
             self.log.with_values(trace_id=trace_id)
             if trace_id is not None
             else self.log
         )
         msgs: List[str] = []
+        violations: List[Dict[str, Any]] = []
         for r in results:
             cname = ((r.constraint or {}).get("metadata") or {}).get(
                 "name", "?"
             )
-            if r.enforcement_action in ("deny", "dryrun") and self.log_denies:
+            if r.enforcement_action in ("deny", "dryrun"):
+                violations.append({
+                    "constraint_kind": (r.constraint or {}).get("kind", ""),
+                    "constraint_name": cname,
+                    "action": r.enforcement_action,
+                    "msg": (r.msg or "")[:256],
+                })
+            if (
+                r.enforcement_action in ("deny", "dryrun")
+                and self.log_denies
+                # shed-burst containment: the decision log's shared
+                # token bucket gates sibling denial-log appends too, so
+                # a deny storm is bounded across BOTH obs sinks
+                and (
+                    self.decision_log is None
+                    or self.decision_log.allow_denial_append()
+                )
+            ):
                 # --log-denies (policy.go:240-252): one structured
                 # record per violation with the reference's key set
                 log.info(
@@ -423,6 +518,8 @@ class ValidationHandler:
                 )
             if r.enforcement_action == "deny":
                 msgs.append(f"[denied by {cname}] {r.msg}")
+        if decision is not None and violations:
+            decision["violations"] = violations
         return msgs
 
     def _validate_gatekeeper_resources(self, request: Dict[str, Any]):

@@ -120,11 +120,21 @@ class MicroBatcher:
         # obs.FlightRecorder: shed bursts (and the default breaker's
         # OPEN transitions) trip postmortem captures
         recorder=None,
+        # obs.DecisionLog: the batch worker stashes per-request
+        # dispatch facts (route, partition set dispatched vs
+        # mask-skipped, rows_dispatched/rows_total, cache/fetch
+        # counts, device-time share) under each request's trace id;
+        # the handler claims them when it records the decision
+        # (docs/observability.md §Decision log)
+        decisions=None,
     ):
         self.client = client
         self.target = target
         self.partitioner = partitioner
         self.recorder = recorder
+        self.decisions = decisions
+        # (constraint generation, corpus size) cache for rows facts
+        self._rows_cache: Optional[Tuple[Any, int]] = None
         if partitioner is not None and breaker is None:
             # the per-device breaker bank replaces the plane breaker
             breaker = False
@@ -194,6 +204,116 @@ class MicroBatcher:
         clock-jump skew (fault point `webhook.clock`) so chaos runs can
         simulate NTP steps without touching the real clock."""
         return time.monotonic() + skew("webhook.clock")
+
+    # -- decision facts (docs/observability.md §Decision log) ----------------
+
+    def _corpus_rows(self) -> Optional[int]:
+        """Constraint-corpus size (the rows_total denominator), cached
+        per constraint generation so the hot path pays one generation
+        read per batch, not a key listing."""
+        drv = getattr(self.client, "_driver", None) if self.client else None
+        keys_fn = getattr(drv, "constraint_keys", None)
+        if keys_fn is None:
+            return None
+        gen_fn = getattr(drv, "constraint_generation", None)
+        gen = gen_fn() if gen_fn is not None else None
+        cached = self._rows_cache
+        if cached is None or cached[0] != gen:
+            try:
+                cached = self._rows_cache = (gen, len(keys_fn(self.target)))
+            except Exception:
+                return None
+        return cached[1]
+
+    def _driver_route(self, n: int) -> str:
+        """What the driver will actually do with an n-request batch:
+        `fused` (device dispatch) or `interp` (the adaptive small-batch
+        / cold-compile interpreter route) — the route fact a decision
+        record explains a request's latency with."""
+        drv = getattr(self.client, "_driver", None) if self.client else None
+        if drv is None or not getattr(drv, "use_jax", False):
+            return "interp"
+        from ..constraint import tpudriver as _td
+
+        warm_fn = getattr(drv, "review_path_warm", None)
+        warm = warm_fn(self.target) if warm_fn is not None else True
+        if n < _td.MIN_DEVICE_BATCH or not warm:
+            return "interp"
+        return "fused"
+
+    def _note_rows(self, partition, rows_dispatched, rows_total) -> None:
+        """The pruning-efficiency series (ROADMAP item 1's instrument):
+        constraint-rows actually dispatched vs the full corpus, per
+        partition — `dispatch_efficiency = dispatched/total` falling
+        with constraint count is what batch-aware pruned dispatch will
+        be judged by."""
+        if self.metrics is None or not rows_total:
+            return
+        self.metrics.record(
+            "dispatch_rows_dispatched_total", rows_dispatched,
+            plane=self.plane, partition=str(partition),
+        )
+        self.metrics.record(
+            "dispatch_rows_total", rows_total,
+            plane=self.plane, partition=str(partition),
+        )
+
+    def _driver_consumption(self) -> Dict[str, Any]:
+        """Per-batch consumption facts from the driver's last dispatch
+        stats: render-cache hits and the batch's device-execute window
+        (apportioned per request by the caller)."""
+        drv = getattr(self.client, "_driver", None) if self.client else None
+        stats = getattr(drv, "stats", None)
+        out: Dict[str, Any] = {}
+        if isinstance(stats, dict):
+            if "render_cache_hits" in stats:
+                out["cache_hits"] = stats["render_cache_hits"]
+            phases = stats.get("phase_seconds") or {}
+            if "device_dispatch" in phases:
+                out["device_seconds"] = phases["device_dispatch"]
+        return out
+
+    def _note_decisions(
+        self, batch, route: str, rows_dispatched=None, rows_total=None,
+        extdata_fetches: Optional[int] = None, per_request=None,
+    ) -> None:
+        """Stash dispatch facts for every traced member request. Batch-
+        shared facts (route, rows, fetches, device share) apply to all;
+        `per_request` maps batch index -> overriding facts (the
+        partitioned path's per-request partition sets)."""
+        if self.decisions is None:
+            return
+        cons = self._driver_consumption()
+        dev = cons.pop("device_seconds", None)
+        base: Dict[str, Any] = {"route": route, "batch_size": len(batch)}
+        base.update(cons)
+        if rows_total is not None:
+            base["rows_total"] = rows_total
+            base["rows_dispatched"] = (
+                rows_dispatched if rows_dispatched is not None
+                else rows_total
+            )
+        if extdata_fetches is not None:
+            base["extdata_fetches"] = extdata_fetches
+        if dev is not None and batch:
+            # the batch's measured device window split evenly across
+            # members — the request-level share of what the constraint-
+            # level CostAttributor accounts exactly
+            base["device_seconds_share"] = round(dev / len(batch), 9)
+        for i, (_, _, ctx, _, _) in enumerate(batch):
+            tid = getattr(ctx, "trace_id", None)
+            if tid is None:
+                continue
+            facts = base
+            if per_request is not None and i in per_request:
+                facts = dict(base)
+                facts.update(per_request[i])
+            self.decisions.note_dispatch(tid, **facts)
+
+    def _extdata_fetch_count(self) -> int:
+        ed = getattr(self.client, "external_data", None) if self.client \
+            else None
+        return int(getattr(ed, "fetch_count", 0) or 0)
 
     def _shed(self, fut: Future, exc: Exception, reason: str,
               ctx=None, sub_wall: Optional[float] = None) -> None:
@@ -354,6 +474,7 @@ class MicroBatcher:
                 )
             self._dispatch_host(batch, reviews, wall0, t0, route="degraded")
             return
+        fetch0 = self._extdata_fetch_count()
         try:
             fire("webhook.batch_dispatch")
             all_responses = self.client.review_many(reviews)
@@ -377,6 +498,18 @@ class MicroBatcher:
             self.metrics.record("webhook_batches_total", 1)
             self.metrics.observe("webhook_batch_size", len(batch))
         self._record_spans(batch, wall0, t0, route="batched")
+        # dispatch-explain facts: the monolithic dispatch evaluates the
+        # whole corpus for every member (no pruning: dispatched == total
+        # under the "mono" partition label)
+        rows = self._corpus_rows()
+        rows_total = rows * len(batch) if rows is not None else None
+        if rows_total is not None:
+            self._note_rows("mono", rows_total, rows_total)
+        self._note_decisions(
+            batch, self._driver_route(len(reviews)),
+            rows_dispatched=rows, rows_total=rows,
+            extdata_fetches=self._extdata_fetch_count() - fetch0,
+        )
         for (_, fut, _, _, _), responses in zip(batch, all_responses):
             resp = responses.by_target.get(self.target)
             fut.set_result(resp.results if resp is not None else [])
@@ -419,6 +552,7 @@ class MicroBatcher:
             self._dispatch_host(batch, reviews, wall0, t0, route="fallback")
             part.run_probes(reviews)
             return
+        fetch0 = self._extdata_fetch_count()
         prefetch = getattr(client, "prefetch_external", None)
         if prefetch is not None:
             # one deduped external-data fetch epoch for the whole batch
@@ -436,12 +570,14 @@ class MicroBatcher:
             masks = [[True] * len(reviews) for _ in plan.partitions]
         fused: List[Any] = []
         host_parts: List[Any] = []
+        skipped_parts: List[int] = []
         for p, mask in zip(plan.partitions, masks):
             if not any(mask):
                 # nothing in this batch touches the partition: zero
                 # cost, zero degraded dispatches — the blast-radius
                 # contract for requests matching only healthy subsets
                 part.note_dispatch("skipped", p.device)
+                skipped_parts.append(p.index)
                 continue
             br = part.breaker(p.device)
             if not br.allow():
@@ -538,6 +674,57 @@ class MicroBatcher:
                         "degraded_subset", wall0, wall1, parent=ctx,
                         plane=self.plane, partitions=sorted(pidx),
                     )
+        # dispatch-explain facts (docs/observability.md §Decision log):
+        # per-partition pruning-efficiency series — a fused partition
+        # evaluated the whole batch, a host partition only its masked
+        # requests, a mask-skipped partition nothing — plus the
+        # per-request partition set and mask-derived rows
+        host_idx = {p.index for p in host_parts}
+        n_rev = len(reviews)
+        key_count = {p.index: len(p.keys) for p in plan.partitions}
+        corpus_rows = sum(key_count.values())
+        for p, mask in zip(plan.partitions, masks):
+            if p.index in skipped_parts:
+                dispatched = 0
+            elif p.index in host_idx:
+                dispatched = key_count[p.index] * sum(
+                    1 for hit in mask if hit
+                )
+            else:
+                dispatched = key_count[p.index] * n_rev
+            self._note_rows(
+                p.index, dispatched, key_count[p.index] * n_rev
+            )
+        if self.decisions is not None:
+            per_request: Dict[int, Dict[str, Any]] = {}
+            for i in range(n_rev):
+                matched = [
+                    p.index
+                    for p in plan.partitions
+                    if masks[p.index][i]
+                ]
+                facts: Dict[str, Any] = {
+                    "partitions_matched": matched,
+                    "partitions_skipped": list(skipped_parts),
+                    "rows_total": corpus_rows,
+                    # the mask-derived per-request rows: constraint
+                    # rows whose partitions this request actually
+                    # touches (what pruned dispatch would pay)
+                    "rows_dispatched": sum(
+                        key_count[j] for j in matched
+                    ),
+                }
+                if i in degraded_reqs:
+                    facts["route"] = "degraded"
+                    facts["partitions_degraded"] = sorted(
+                        degraded_reqs[i]
+                    )
+                per_request[i] = facts
+            self._note_decisions(
+                batch, self._driver_route(n_rev),
+                extdata_fetches=self._extdata_fetch_count() - fetch0,
+                per_request=per_request,
+            )
         for i, (_, fut, _, _, _) in enumerate(batch):
             if i in errors:
                 fut.set_exception(errors[i])
@@ -565,6 +752,7 @@ class MicroBatcher:
             for _, fut, _, _, _ in batch:
                 fut.set_exception(EvaluationUnavailable(str(e)))
             self._record_spans(batch, wall0, t0, route="unavailable")
+            self._note_decisions(batch, "unavailable")
             return
         prefetch = getattr(self.client, "prefetch_external", None)
         if prefetch is not None:
@@ -580,6 +768,7 @@ class MicroBatcher:
         host = getattr(self.client, "review_host", None)
         if host is None:
             host = self.client.review
+        fetch0 = self._extdata_fetch_count()
         for review, (_, fut, _, _, _) in zip(reviews, batch):
             try:
                 responses = host(review)
@@ -588,6 +777,17 @@ class MicroBatcher:
             except Exception as e:
                 fut.set_exception(e)
         self._record_spans(batch, wall0, t0, route=route)
+        # host rung facts: every corpus row still evaluates, on the
+        # interpreter — "degraded" when the breaker steered here,
+        # "host" when a failed fused attempt fell back
+        rows = self._corpus_rows()
+        if rows is not None:
+            self._note_rows("mono", rows * len(batch), rows * len(batch))
+        self._note_decisions(
+            batch, "degraded" if route == "degraded" else "host",
+            rows_dispatched=rows, rows_total=rows,
+            extdata_fetches=self._extdata_fetch_count() - fetch0,
+        )
 
     def _record_spans(self, batch, wall0: float, t0: float, route: str) -> None:
         """Stamp this batch's shared timing window into every traced
@@ -742,10 +942,16 @@ class WebhookServer:
         # the plane breaker, and the partitioner's per-device breakers
         # so a trip anywhere on this server captures one postmortem
         recorder=None,
+        # obs.DecisionLog: per-admission "why" records across every
+        # plane this server mounts (validation / mutation / agent);
+        # None = decision plane off (docs/observability.md §Decision
+        # log; bench_webhook --attribution measures the on/off delta)
+        decision_log=None,
     ):
         self.client = client  # warmup() compiles through it
         self.tracer = tracer
         self.recorder = recorder
+        self.decision_log = decision_log
         self.request_timeout = request_timeout
         self.drain_grace_s = drain_grace_s
         self.partitioner = None
@@ -778,6 +984,7 @@ class WebhookServer:
             max_queue=max_queue,
             partitioner=self.partitioner,
             recorder=recorder,
+            decisions=decision_log,
         )
         self.mutate_batcher = None
         self.mutation_handler = None
@@ -790,6 +997,7 @@ class WebhookServer:
                 namespace_getter=namespace_getter,
                 metrics=metrics, tracer=tracer,
                 max_queue=max_queue,
+                decisions=decision_log,
             )
             self.mutation_handler = MutationHandler(
                 self.mutate_batcher,
@@ -799,6 +1007,7 @@ class WebhookServer:
                 logger=logger,
                 tracer=tracer,
                 fail_policy=fail_policy,
+                decision_log=decision_log,
             )
         self.handler = BatchedValidationHandler(
             self.batcher, excluder=excluder, metrics=metrics,
@@ -810,6 +1019,7 @@ class WebhookServer:
             logger=logger,
             tracer=tracer,
             fail_policy=fail_policy,
+            decision_log=decision_log,
         )
         self.label_handler = NamespaceLabelHandler(exempt_namespaces)
         self.agent_batcher = None
@@ -832,6 +1042,7 @@ class WebhookServer:
                 fail_policy=fail_policy,
                 request_timeout=request_timeout,
                 max_queue=max_queue,
+                decision_log=decision_log,
             )
         outer = self
 

@@ -1,0 +1,589 @@
+"""Decision-log plane: schema contract, sampling determinism, rate
+gating, dispatch-fact parity, and the HTTP acceptance e2e
+(docs/observability.md §Decision log).
+
+What it pins:
+  * the DecisionRecord schema (`DECISION_SCHEMA_FIELDS`) — every
+    retained record carries the full field set, on every plane;
+  * head+error sampling is DETERMINISTIC (CRC of the trace id): two
+    logs with the same sample rate keep the same allow subset, and
+    denials / sheds / degraded routes / the slow tail are never
+    sampled out;
+  * the token-bucket rate gate bounds ring AND denial-log appends
+    during bursts, counted in `decisions_dropped_total`;
+  * route/mask fact parity — the per-request `rows_dispatched`
+    recorded from `partition_match_mask` equals the mask-derived
+    ground truth on the partition parity battery templates, and
+    dispatching ONLY the mask-matched partitions merges to the
+    monolithic verdicts (the fact a pruned dispatch would act on);
+  * the acceptance e2e — `/debug/decisions?trace_id=` returns a
+    record whose route/partition facts match the request's trace
+    spans, on both export formats;
+  * flight record ↔ decision cross-link — a breaker-tripping chaos
+    run produces a flight record embedding the trigger window's
+    decision ids, and BOTH records retrieve over HTTP by the shared
+    trace id.
+
+Runs in tier-1 (numpy-mode TpuDriver: no jit compiles, deterministic).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget, TpuDriver
+from gatekeeper_tpu.faults import FAULTS, OPEN, device_point
+from gatekeeper_tpu.metrics import MetricsRegistry, serve_metrics
+from gatekeeper_tpu.obs import (
+    DECISION_SCHEMA_FIELDS,
+    DecisionLog,
+    FlightRecorder,
+    Tracer,
+    check_decision_schema,
+)
+from gatekeeper_tpu.webhook.server import (
+    BatchedValidationHandler,
+    MicroBatcher,
+    WebhookServer,
+)
+
+pytestmark = pytest.mark.obs
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+REQ_LABELS = """package reqlabels
+
+violation[{"msg": msg}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("missing: %v", [missing])
+}
+"""
+
+NAMESPACES = ["ns-a", "ns-b", "ns-c", "ns-d"]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def counter(metrics, name, **tags):
+    snap = metrics.snapshot()["counters"]
+    total = 0
+    for key, v in snap.items():
+        if not key.startswith(name):
+            continue
+        if all(f'{k}="{val}"' in key for k, val in tags.items()):
+            total += v
+    return total
+
+
+def build_ns_client():
+    """4 constraint kinds, each matching exactly one namespace — one
+    namespace addresses one partition under a k=4 plan (the chaos
+    suite's fault-domain layout)."""
+    cl = Backend(TpuDriver(use_jax=False)).new_client(K8sValidationTarget())
+    for i, ns in enumerate(NAMESPACES):
+        kind = f"Dec{chr(65 + i)}"
+        cl.add_template({
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": kind.lower()},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": kind}}},
+                "targets": [{
+                    "target": TARGET,
+                    "rego": REQ_LABELS.replace("reqlabels", kind.lower()),
+                }],
+            },
+        })
+        cl.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind,
+            "metadata": {"name": f"need-owner-{ns}"},
+            "spec": {
+                "match": {"namespaces": [ns]},
+                "parameters": {"labels": ["owner"]},
+            },
+        })
+    return cl
+
+
+def ns_request(i, ns, labels=None):
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"p{i}",
+            "namespace": ns,
+            **({"labels": labels} if labels else {}),
+        },
+        "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+    }
+    return {
+        "uid": f"uid-{i}",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": "CREATE",
+        "name": f"p{i}",
+        "namespace": ns,
+        "userInfo": {"username": "alice"},
+        "object": obj,
+    }
+
+
+# -- unit: schema, sampling, rate gate ---------------------------------------
+
+
+def test_record_schema_contract_every_plane():
+    """Every retained record carries the full DECISION_SCHEMA_FIELDS
+    set, whatever plane wrote it."""
+    log = DecisionLog(allow_sample_n=1)
+    recs = [
+        log.record_decision("validation", "deny", code=403, trace_id="a" * 32,
+                   duration_ms=3.0,
+                   violations=[{"constraint_name": "c0"}]),
+        log.record_decision("mutation", "allow", trace_id="b" * 32,
+                   duration_ms=1.0, mutation_status="mutated"),
+        log.record_decision("agent", "deny", code=403, trace_id="c" * 32,
+                   tenant={"agent": "planner-1", "session": "s-1"}),
+        log.record_decision("audit", "deny", route="audit",
+                   trace_id="d" * 32),
+    ]
+    for rec in recs:
+        assert rec is not None
+        assert check_decision_schema(rec) == [], rec
+    # the agent record's tenant is the (agent, session) identity
+    agent = log.records(plane="agent")[0]
+    assert agent["tenant"] == {"agent": "planner-1", "session": "s-1"}
+    assert set(DECISION_SCHEMA_FIELDS) <= set(recs[0].keys())
+
+
+def test_allow_sampling_is_deterministic_by_trace_id():
+    """Same trace-id universe + same rate -> the SAME kept subset in
+    two independent logs (CRC-based, process-salt-free); the rate is
+    approximately honored."""
+    ids = [f"{i:032x}" for i in range(400)]
+    kept = []
+    for _ in range(2):
+        log = DecisionLog(allow_sample_n=8, max_per_s=0)
+        for tid in ids:
+            log.record_decision("validation", "allow", trace_id=tid)
+        kept.append({r["trace_id"] for r in log.records(limit=1000)})
+    assert kept[0] == kept[1]
+    assert 0 < len(kept[0]) < len(ids)
+    # roughly 1-in-8 (binomial slack)
+    assert len(ids) / 16 < len(kept[0]) < len(ids) / 3
+    # sampled-out accounting
+    log2 = DecisionLog(allow_sample_n=8, max_per_s=0)
+    for tid in ids:
+        log2.record_decision("validation", "allow", trace_id=tid)
+    snap = log2.snapshot()
+    assert snap["recorded"] + snap["sampled_out"] == len(ids)
+
+
+def test_error_half_is_never_sampled_out():
+    """Denials, sheds, unavailable, degraded/host routes, and the slow
+    tail are ALWAYS retained — head sampling only touches plain fast
+    allows."""
+    log = DecisionLog(allow_sample_n=0, slow_ms=100.0, max_per_s=0)
+    assert log.record_decision("validation", "allow", trace_id="1" * 32) is None
+    assert log.record_decision("validation", "deny", trace_id="2" * 32)
+    assert log.record_decision("validation", "shed", trace_id="3" * 32)
+    assert log.record_decision("validation", "unavailable", trace_id="4" * 32)
+    assert log.record_decision("validation", "allow", trace_id="5" * 32,
+                      route="degraded")
+    assert log.record_decision("validation", "allow", trace_id="6" * 32,
+                      route="host")
+    # slow tail: 150ms > slow_ms
+    assert log.record_decision("validation", "allow", trace_id="7" * 32,
+                      duration_ms=150.0)
+    verdicts = [r["verdict"] for r in log.records(limit=100)]
+    assert "allow" in verdicts and "deny" in verdicts
+    assert log.snapshot()["recorded"] == 6
+
+
+def test_rate_gate_bounds_ring_and_denial_log_appends():
+    """A burst past the token bucket drops appends — counted in
+    decisions_dropped_total — and the denial-log gate shares the same
+    budget (the shed-burst containment satellite)."""
+    metrics = MetricsRegistry()
+    clock = [0.0]
+    log = DecisionLog(
+        metrics=metrics, allow_sample_n=1, max_per_s=10,
+        clock=lambda: clock[0],
+    )
+    kept = sum(
+        1
+        for i in range(50)
+        if log.record_decision("validation", "deny", trace_id=f"{i:032x}")
+    )
+    assert kept < 50
+    snap = log.snapshot()
+    assert snap["dropped"] == 50 - kept
+    assert counter(
+        metrics, "decisions_dropped_total", reason="rate_limited"
+    ) == 50 - kept
+    # the denial-log gate draws from the same (exhausted) bucket
+    assert log.allow_denial_append() is False
+    assert log.snapshot()["denial_log_dropped"] == 1
+    assert counter(
+        metrics, "decisions_dropped_total", reason="denial_log_rate"
+    ) == 1
+    # refill: time passes, appends flow again
+    clock[0] = 10.0
+    assert log.record_decision("validation", "deny", trace_id="f" * 32)
+    assert log.allow_denial_append() is True
+
+
+def test_ring_and_disk_spool_bounded(tmp_path):
+    log = DecisionLog(
+        max_records=8, allow_sample_n=1, max_per_s=0,
+        dir=str(tmp_path),
+    )
+    for i in range(40):
+        log.record_decision("validation", "deny", trace_id=f"{i:032x}")
+    assert log.snapshot()["retained"] == 8
+    rows = log.records(limit=100)
+    assert len(rows) == 8
+    assert rows[0]["trace_id"] == f"{39:032x}"  # newest first
+    spool = (tmp_path / "decisions.ndjson").read_text().splitlines()
+    # the spool rewrites from the bounded ring every max_records
+    # appends, so it can never outgrow ~2x the ring
+    assert len(spool) <= 2 * 8
+    assert all(json.loads(line)["plane"] == "validation"
+               for line in spool)
+
+
+def test_note_dispatch_facts_merge_and_bound():
+    """Facts stash: merge-on-repeat (validation + mutate facts on one
+    trace), popped exactly once by record(), bounded."""
+    log = DecisionLog(allow_sample_n=1, max_per_s=0)
+    log.note_dispatch("t1", route="fused", rows_total=10)
+    log.note_dispatch("t1", fixpoint_iterations=3)
+    rec = log.record_decision("validation", "allow", trace_id="t1")
+    assert rec["route"] == "fused"
+    assert rec["rows_total"] == 10
+    assert rec["fixpoint_iterations"] == 3
+    # popped: a second record on the same trace carries no facts
+    rec2 = log.record_decision("validation", "deny", trace_id="t1")
+    assert rec2["route"] is None
+    # bounded: orphans evict oldest-first
+    for i in range(log._facts_max + 50):
+        log.note_dispatch(f"orphan-{i}", route="fused")
+    assert log.snapshot()["pending_facts"] <= log._facts_max
+
+
+# -- route/mask fact parity (the partition parity battery) -------------------
+
+
+def test_mask_fact_parity_vs_merge_partition_results():
+    """On the partition parity battery templates (VECTORIZED +
+    PARTIAL_ROWS + INTERPRETER + autorejects): the decision facts'
+    mask-derived rows_dispatched equals ground truth, and dispatching
+    ONLY the mask-matched partitions merges to the monolithic verdicts
+    — the mask facts a decision record reports are exactly the rows a
+    pruned dispatch could pay and still answer correctly."""
+    from test_partition import (
+        augmented,
+        battery_request,
+        build_battery_client,
+        normalize,
+    )
+
+    from gatekeeper_tpu.parallel.partition import (
+        build_plan,
+        merge_partition_results,
+    )
+
+    cl = build_battery_client(9)
+    keys = cl._driver.constraint_keys(TARGET)
+    plan = build_plan(keys, 3, range(3), frozenset(range(3)))
+    reviews = augmented(cl, [battery_request(i) for i in range(12)])
+    masks = cl.partition_match_mask(
+        reviews, [p.subset for p in plan.partitions]
+    )
+    mono = cl.review_many(reviews)
+    corpus_rows = sum(len(p.keys) for p in plan.partitions)
+    assert corpus_rows == len(keys)
+    for i in range(len(reviews)):
+        matched = [p for p in plan.partitions if masks[p.index][i]]
+        # the decision fact: rows for partitions this request touches
+        rows_dispatched = sum(len(p.keys) for p in matched)
+        assert rows_dispatched <= corpus_rows
+        # dispatch ONLY the matched partitions; merged == monolith
+        per_part = [
+            cl.review_many_subset([reviews[i]], p.subset,
+                                  device=p.device)[0]
+            for p in matched
+        ]
+        merged = merge_partition_results(
+            [
+                (pp.by_target[TARGET].results
+                 if TARGET in pp.by_target else [])
+                for pp in per_part
+            ],
+            plan.order,
+        )
+        expect = (
+            mono[i].by_target[TARGET].results
+            if TARGET in mono[i].by_target else []
+        )
+        assert normalize(merged) == normalize(expect), f"request {i}"
+
+
+# -- acceptance e2e: HTTP decision vs trace parity ---------------------------
+
+
+def test_debug_decisions_http_matches_trace_spans():
+    """The ISSUE 11 acceptance probe: POST /v1/admit on a partitioned
+    WebhookServer, then GET /debug/decisions?trace_id= on the metrics
+    plane — the returned record's route/partition facts must match the
+    request's trace spans, the envelope's verdict, and the mask ground
+    truth; ?format=ndjson and ?verdict= filters work."""
+    client = build_ns_client()
+    metrics = MetricsRegistry()
+    tracer = Tracer()
+    decisions = DecisionLog(
+        metrics=metrics, replica="r0", allow_sample_n=1, max_per_s=0
+    )
+    srv = WebhookServer(
+        client, TARGET, metrics=metrics, tracer=tracer,
+        decision_log=decisions, partitions=4, log_denies=True,
+    )
+    srv.start()
+    httpd = serve_metrics(metrics, tracer=tracer, decisions=decisions)
+    port = httpd.server_address[1]
+    try:
+        def post(i, ns, labels=None):
+            body = json.dumps({
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": ns_request(i, ns, labels=labels),
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/admit", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+
+        deny = post(0, "ns-b")
+        assert deny["response"]["allowed"] is False
+        tid = deny["traceId"]
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/decisions?trace_id={tid}",
+            timeout=5,
+        ) as r:
+            doc = json.loads(r.read())
+        assert len(doc["decisions"]) == 1
+        rec = doc["decisions"][0]
+        assert check_decision_schema(rec) == []
+        assert rec["replica"] == "r0"
+        assert rec["verdict"] == "deny" and rec["code"] == 403
+        assert rec["violations"][0]["constraint_name"] == (
+            "need-owner-ns-b"
+        )
+        assert rec["tenant"] == {
+            "namespace": "ns-b", "username": "alice",
+        }
+        # mask ground truth: ns-b touches exactly one partition (one
+        # constraint of four); the other three are mask-skipped
+        assert rec["rows_total"] == 4
+        assert rec["rows_dispatched"] == 1
+        assert len(rec["partitions_matched"]) == 1
+        assert len(rec["partitions_skipped"]) == 3
+        assert set(rec["partitions_matched"]).isdisjoint(
+            rec["partitions_skipped"]
+        )
+        assert rec["deadline_slack_ms"] > 0
+
+        # parity with the trace: same trace id, and the dispatch
+        # span's route agrees with the record's
+        trace = tracer.get(tid)
+        assert trace is not None
+        dispatch_spans = [
+            s for s in trace["spans"] if s["name"] == "dispatch"
+        ]
+        assert dispatch_spans
+        # batcher route "batched"/"partitioned" <-> record route
+        # fused/interp (numpy driver => interp); degraded would match
+        # a degraded_subset span (pinned in the cross-link test)
+        assert rec["route"] in ("fused", "interp")
+        assert dispatch_spans[0]["attrs"]["route"] in (
+            "batched", "partitioned"
+        )
+        span_names = {s["name"] for s in trace["spans"]}
+        assert "degraded_subset" not in span_names
+
+        # the pruning-efficiency series accumulated mask facts: the
+        # three untouched partitions dispatched zero rows
+        dispatched = sum(
+            v for k, v in metrics.snapshot()["counters"].items()
+            if k.startswith("dispatch_rows_dispatched_total")
+        )
+        total = sum(
+            v for k, v in metrics.snapshot()["counters"].items()
+            if k.startswith("dispatch_rows_total")
+        )
+        assert total == 4 and dispatched == 1
+
+        # ndjson export + verdict filter
+        post(1, "ns-a", labels={"owner": "x"})  # an allow
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/decisions"
+            f"?verdict=deny&format=ndjson",
+            timeout=5,
+        ) as r:
+            lines = r.read().decode().strip().splitlines()
+        assert lines
+        assert all(
+            json.loads(line)["verdict"] == "deny" for line in lines
+        )
+        assert counter(
+            metrics, "decisions_recorded_total",
+            plane="validation", verdict="deny",
+        ) == 1
+    finally:
+        srv.stop()
+        httpd.shutdown()
+
+
+# -- flight record <-> decision cross-link (chaos e2e) -----------------------
+
+
+def test_flight_record_embeds_decisions_retrievable_by_trace_id():
+    """Chaos cross-link e2e: a device fault trips the per-device
+    breaker -> ONE flight record whose `decisions` section names the
+    trigger window's degraded/denied decision ids + trace ids, and
+    BOTH documents retrieve over HTTP by the shared trace id."""
+    from gatekeeper_tpu.parallel.partition import PartitionDispatcher
+
+    client = build_ns_client()
+    metrics = MetricsRegistry()
+    tracer = Tracer()
+    decisions = DecisionLog(
+        metrics=metrics, allow_sample_n=1, max_per_s=0
+    )
+    recorder = FlightRecorder(
+        tracer=tracer, metrics=metrics, decisions=decisions,
+        min_interval_s=300.0, debounce_s=0.1,
+    )
+    clock = [0.0]
+    disp = PartitionDispatcher(
+        client, TARGET, k=4, metrics=metrics, tracer=tracer,
+        failure_threshold=2, recovery_seconds=5.0,
+        clock=lambda: clock[0], recorder=recorder,
+    )
+    batcher = MicroBatcher(
+        client, TARGET, window_ms=1.0, metrics=metrics, tracer=tracer,
+        partitioner=disp, decisions=decisions,
+    )
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=5.0, metrics=metrics, tracer=tracer,
+        fail_policy="open", decision_log=decisions,
+    )
+    batcher.start()
+    httpd = serve_metrics(
+        metrics, tracer=tracer, recorder=recorder, decisions=decisions
+    )
+    port = httpd.server_address[1]
+    try:
+        # plan builds healthy, then device 1 (ns-b's partition) sickens
+        for i, ns in enumerate(NAMESPACES):
+            assert not handler.handle(ns_request(i, ns)).allowed
+        FAULTS.arm(device_point("driver.device_dispatch", 1),
+                   mode="error")
+        for i in range(2):
+            resp = handler.handle(ns_request(30 + i, "ns-b"))
+            assert not resp.allowed and resp.code == 403  # host verdict
+        assert disp.breaker(1).state == OPEN
+
+        deadline = time.monotonic() + 5.0
+        while not recorder.records() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        recorder.flush()
+        records = recorder.records()
+        assert records and records[0]["trigger"] == "breaker_open"
+        linked = records[0].get("decisions") or []
+        assert linked, records[0].keys()
+        # the linked decisions are the degraded ns-b requests
+        degraded = [d for d in linked if d.get("route") == "degraded"]
+        assert degraded
+        tid = degraded[0]["trace_id"]
+        assert tid
+
+        # both documents retrieve over HTTP by the shared trace id
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/decisions?trace_id={tid}",
+            timeout=5,
+        ) as r:
+            ddoc = json.loads(r.read())
+        assert len(ddoc["decisions"]) == 1
+        rec = ddoc["decisions"][0]
+        assert rec["id"] == degraded[0]["id"]
+        assert rec["route"] == "degraded"
+        assert rec["partitions_degraded"] == [1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/flightrecords", timeout=5
+        ) as r:
+            fdoc = json.loads(r.read())
+        assert any(
+            d.get("trace_id") == tid
+            for fr in fdoc["records"]
+            for d in fr.get("decisions", [])
+        )
+        # the trace itself confirms the degraded route
+        trace = tracer.get(tid)
+        assert trace is not None
+        assert any(
+            s["name"] == "degraded_subset" for s in trace["spans"]
+        )
+    finally:
+        FAULTS.reset()
+        batcher.stop()
+        disp.close()
+        recorder.stop()
+        httpd.shutdown()
+
+
+# -- handler-level verdicts for the overload path ----------------------------
+
+
+def test_shed_decisions_recorded_with_typed_verdict():
+    """A queue-full shed records verdict='shed' with the typed reason —
+    the overload story is reconstructible from decisions alone."""
+    from gatekeeper_tpu.webhook import ValidationHandler
+
+    client = build_ns_client()
+    decisions = DecisionLog(allow_sample_n=0, max_per_s=0)
+    batcher = MicroBatcher(
+        client, TARGET, window_ms=5.0, max_queue=0,
+        decisions=decisions,
+    )
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=1.0, fail_policy="open",
+        decision_log=decisions,
+    )
+    # no batcher.start(): max_queue=0 sheds at submit
+    resp = handler.handle(ns_request(0, "ns-a"))
+    assert resp.allowed  # fail-open envelope
+    recs = decisions.records(verdict="shed")
+    assert len(recs) == 1
+    assert recs[0]["reason"] == "queue_full"
+    assert recs[0]["plane"] == "validation"
+
+    # the serial (non-batched) handler records decisions too
+    serial = ValidationHandler(
+        client, TARGET, decision_log=decisions,
+    )
+    assert not serial.handle(ns_request(1, "ns-b")).allowed
+    assert decisions.records(verdict="deny", plane="validation")

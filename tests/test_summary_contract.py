@@ -1,0 +1,249 @@
+"""The bench SUMMARY schema contract + the bench_compare trajectory
+gate (gatekeeper_tpu/summary.py, bench_compare.py).
+
+Every `bench_webhook.py` mode's summarizer is driven through the
+STRICT shared reader with a representative result shape, so a lane
+whose headline fields drift — or a new lane that forgets the contract
+— fails here instead of in a future postmortem. The soak reader is
+pinned as the same contract's soak instance, and bench_compare is
+pinned to flag p50/p99/dispatch-efficiency regressions (and only
+regressions) past its threshold.
+"""
+
+import json
+
+import pytest
+
+import bench_compare
+import bench_webhook
+from gatekeeper_tpu.summary import (
+    REQUIRED_FIELDS,
+    SUMMARY_PREFIX,
+    check_summary,
+    find_summary,
+    format_summary,
+    parse_summary_line,
+)
+
+pytestmark = pytest.mark.obs
+
+
+# representative result shapes per bench mode (the minimal doc each
+# lane actually produces; a summarizer change that breaks a headline
+# field breaks the strict parse below)
+MODE_RESULTS = {
+    "webhook": {
+        "tpu_batched": [{
+            "violating": True, "concurrency": 8,
+            "p50_ms": 1.2, "p99_ms": 3.4, "throughput_rps": 850.0,
+        }],
+    },
+    "ladder": {
+        "rungs": [{"constraints": 5, "fused": {"p50_ms": 1.0}}],
+        "skipped": [2000],
+    },
+    "attribution": {
+        "rungs": [{
+            "constraints": 200, "sums_ok": True,
+            "attribution_ratio": 1.0, "dispatch_efficiency": 0.25,
+            "top_costs": [{"kind": "AttrLabels", "name": "a0001"}],
+        }],
+        "decision_overhead": {"p50_overhead_frac": 0.02},
+    },
+    "partitions": {
+        "parity_ok": True, "healthy_subset_degraded": 0,
+        "degraded_coverage_fraction": 0.25, "recovery_s": 1.4,
+        "home_restored": True,
+        "phases": [{"phase": "recovered", "p50_ms": 2.0}],
+    },
+    "fleet": {
+        "fetches_per_key_n1": 1.0, "fetches_per_key_n2_isolated": 2.0,
+        "fetches_per_key_n2_fleet": 1.0,
+        "cold_fetch_amplification": 1.0, "phases": [],
+    },
+    "chaos": {
+        "phases": [{
+            "phase": "recovered", "p50_ms": 1.5, "p99_ms": 9.0,
+            "throughput_rps": 400.0, "shed_rate": 0.0,
+        }],
+    },
+    "external": {
+        "phases": [{
+            "phase": "warm_deny", "p50_ms": 2.0, "p99_ms": 6.0,
+            "cache_hit_rate": 1.0, "fetches_per_batch": 0.0,
+        }],
+    },
+    "mutate": {
+        "replays": [{
+            "p50_ms": 1.1, "p99_ms": 4.2, "throughput_rps": 700.0,
+            "batch_occupancy": 12.0,
+        }],
+    },
+}
+
+
+def test_every_bench_mode_summary_round_trips_strict():
+    """Writer -> strict reader for every bench_webhook mode: the line
+    parses, the mode survives, and every required headline field is
+    present."""
+    for mode, res in MODE_RESULTS.items():
+        line = bench_webhook._summarize(mode, res)
+        assert line.startswith(SUMMARY_PREFIX)
+        doc = parse_summary_line(line, mode=mode)
+        assert doc["mode"] == mode
+        for f in REQUIRED_FIELDS[mode]:
+            assert f in doc, (mode, f)
+
+
+def test_contract_covers_every_bench_mode_flag():
+    """The REQUIRED_FIELDS map names every bench_webhook.py mode flag
+    (the satellite's enumeration: a new lane must register here)."""
+    with open(bench_webhook.__file__) as f:
+        src = f.read()
+    for mode in ("ladder", "attribution", "partitions", "fleet",
+                 "chaos", "external", "mutate", "soak"):
+        assert f'"--{mode}"' in src, f"bench flag --{mode} vanished?"
+        assert mode in REQUIRED_FIELDS, f"mode {mode!r} unregistered"
+    assert "webhook" in REQUIRED_FIELDS  # the default (flagless) lane
+
+
+def test_soak_reader_is_the_shared_contract():
+    """soak.report.parse_summary_line delegates to the shared strict
+    reader: valid round-trip, wrong-mode rejection, missing-field
+    rejection."""
+    from gatekeeper_tpu.soak.report import (
+        parse_summary_line as soak_parse,
+        summarize_soak,
+    )
+
+    doc = {
+        "scenario": {"name": "smoke", "duration_s": 10},
+        "open_loop": {"target_rps": 40, "achieved_rps": 39.8},
+        "slo": {"attainment": 0.998, "worst_window_p99_ms": 80.0},
+        "shed": {"rate": 0.0},
+        "leak": {"flagged": []},
+        "checks": {"leak_flat": True},
+        "breaker_transitions": [],
+        "flight_records": [{"captured": 1}],
+    }
+    parsed = soak_parse(summarize_soak(doc))
+    assert parsed["mode"] == "soak"
+    assert parsed["slo_attainment"] == 0.998
+    assert parsed["flight_records"] == 1
+    with pytest.raises(ValueError):
+        soak_parse(bench_webhook._summarize(
+            "chaos", MODE_RESULTS["chaos"]
+        ))
+    with pytest.raises(ValueError):
+        soak_parse('SUMMARY: {"mode": "soak", "shed_rate": 0.0}')
+
+
+def test_check_summary_lints_and_error_escape():
+    assert check_summary({"mode": "nope"}) == [
+        "unknown summary mode: 'nope'"
+    ]
+    assert check_summary({}) == ["missing field: mode"]
+    missing = check_summary({"mode": "webhook", "p50_ms": 1.0})
+    assert any("p99_ms" in p for p in missing)
+    # a summarizer that crashed reports error= instead of headlines;
+    # the reader surfaces the doc rather than a field lint
+    assert check_summary({"mode": "webhook", "error": "boom"}) == []
+
+
+def test_find_summary_takes_last_valid_line():
+    text = "\n".join([
+        "noise",
+        format_summary("webhook", {"p50_ms": 1, "p99_ms": 2,
+                                   "throughput_rps": 3}),
+        "SUMMARY: not-json{",
+        format_summary("webhook", {"p50_ms": 9, "p99_ms": 10,
+                                   "throughput_rps": 11}),
+    ])
+    doc = find_summary(text)
+    assert doc["p50_ms"] == 9
+    assert find_summary("no summaries here") is None
+
+
+# -- bench_compare: the trajectory gate --------------------------------------
+
+
+def _attr_doc(p50, p99, eff, rps=100.0):
+    return {
+        "rungs": [{
+            "constraints": 200,
+            "replay": {"p50_ms": p50, "p99_ms": p99,
+                       "throughput_rps": rps},
+            "dispatch_efficiency": eff,
+        }],
+    }
+
+
+def test_bench_compare_flags_directional_regressions():
+    base = _attr_doc(10.0, 40.0, 0.25)
+    # p50 +50%, efficiency 0.25 -> 0.8 (pruning got worse), p99 flat
+    cand = _attr_doc(15.0, 41.0, 0.80)
+    rep = bench_compare.compare_runs(base, cand, threshold=0.20)
+    assert not rep["ok"]
+    flagged = {r["metric"].rsplit(".", 1)[-1] for r in rep["regressions"]}
+    assert flagged == {"p50_ms", "dispatch_efficiency"}
+    # worst offender first
+    assert rep["regressions"][0]["metric"].endswith(
+        "dispatch_efficiency"
+    )
+
+
+def test_bench_compare_good_directions_are_improvements():
+    base = _attr_doc(10.0, 40.0, 0.8, rps=100.0)
+    cand = _attr_doc(5.0, 20.0, 0.2, rps=300.0)  # all better
+    rep = bench_compare.compare_runs(base, cand, threshold=0.20)
+    assert rep["ok"] and not rep["regressions"]
+    assert len(rep["improvements"]) == 4
+    # throughput regression IS flagged when it falls
+    rep2 = bench_compare.compare_runs(
+        _attr_doc(10, 40, 0.5, rps=300.0),
+        _attr_doc(10, 40, 0.5, rps=100.0),
+        threshold=0.20,
+    )
+    assert [r["metric"].rsplit(".", 1)[-1] for r in rep2["regressions"]] \
+        == ["throughput_rps"]
+
+
+def test_bench_compare_aligns_rows_by_context_not_index():
+    """A rung skipped in one run must not shift comparisons: rows key
+    on their context fields (constraints/phase/...), not list order."""
+    base = {"rungs": [
+        {"constraints": 10, "replay": {"p50_ms": 1.0}},
+        {"constraints": 200, "replay": {"p50_ms": 10.0}},
+    ]}
+    cand = {"rungs": [  # the c=10 rung was time-budget-skipped
+        {"constraints": 200, "replay": {"p50_ms": 10.5}},
+    ]}
+    rep = bench_compare.compare_runs(base, cand, threshold=0.20)
+    assert rep["ok"]
+    assert rep["compared"] == 1  # only the shared c=200 row
+
+
+def test_bench_compare_loads_artifacts_and_summary_logs(tmp_path):
+    art = tmp_path / "base.json"
+    art.write_text(json.dumps(_attr_doc(10.0, 40.0, 0.25)))
+    log = tmp_path / "cand.log"
+    log.write_text(
+        "bench noise\n"
+        + format_summary("attribution", {
+            "rungs": 3, "sums_ok": True, "attribution_ratio": 1.0,
+            "dispatch_efficiency": {"200": 0.9},
+        })
+        + "\n"
+    )
+    base = bench_compare.load_run(str(art))
+    cand = bench_compare.load_run(str(log))
+    rep = bench_compare.compare_runs(base, cand)
+    # artifact rung vs summary map share no stable path -> compared 0,
+    # but both load without error (truncation-survivor path)
+    assert rep["compared"] >= 0
+    # the CLI returns 1 on regression
+    cand2 = tmp_path / "cand.json"
+    cand2.write_text(json.dumps(_attr_doc(20.0, 40.0, 0.25)))
+    assert bench_compare.main([str(art), str(cand2)]) == 1
+    same = bench_compare.main([str(art), str(art)])
+    assert same == 0
