@@ -41,6 +41,9 @@ WATCHED: Dict[str, int] = {
     "p99_ms": +1,
     "worst_window_p99_ms": +1,
     "dispatch_efficiency": +1,
+    # pruning width: more partitions touched per batch = less pruning
+    "partitions_touched_p50": +1,
+    "partitions_touched_max": +1,
     "shed_rate": +1,
     "cold_fetch_amplification": +1,
     "throughput_rps": -1,

@@ -53,7 +53,15 @@ def build_webhook_client(driver, n_constraints):
         client.add_template(_load_template(f"{tdir}/template.yaml"))
     for i in range(n_constraints):
         tdir, kind, params = WEBHOOK_MIX[i % len(WEBHOOK_MIX)]
-        spec = {"match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}}
+        # namespace affinity aligned with make_request's ns{i % 11}: a
+        # constraint governs one namespace, so the locality planner can
+        # co-locate each namespace's constraints and mask-gated pruned
+        # dispatch pays only the partitions a batch's namespaces touch
+        # (the reference's per-team constraint scoping, at bench scale)
+        spec = {"match": {
+            "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+            "namespaces": [f"ns{i % 11}"],
+        }}
         if params is not None:
             spec["parameters"] = params
         client.add_constraint(
@@ -1176,9 +1184,15 @@ def build_attribution_client(driver, n_constraints):
         })
     for i in range(n_constraints):
         kind, _rego, params = mix[i % len(mix)]
-        spec = {"match": {"kinds": [
-            {"apiGroups": [""], "kinds": ["Pod"]}
-        ]}}
+        # namespace affinity aligned with make_request's ns{i % 11}
+        # (same scoping as build_webhook_client): gives the locality
+        # planner real structure to co-locate, so the lane measures
+        # pruned dispatch with falling dispatch_efficiency instead of
+        # an unprunable all-match corpus
+        spec = {"match": {
+            "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+            "namespaces": [f"ns{i % 11}"],
+        }}
         if params is not None:
             spec["parameters"] = params
         client.add_constraint({
@@ -1255,9 +1269,15 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
         # as an off/on phase pair with the tracer on throughout)
         tracer = Tracer(max_traces=2048)
         decisions = DecisionLog(metrics=metrics, max_per_s=0)
+        # partition count scales with the corpus (floor k): bigger
+        # corpora split finer, so the locality planner can isolate each
+        # namespace group and mask-gated pruning drives
+        # dispatch_efficiency DOWN as constraint count grows — the
+        # inverse of the pre-pruning flat-1.0 ladder
+        k_rung = min(n_con, max(k, n_con // 8), 64)
         disp = PartitionDispatcher(
-            client, TARGET, k=min(k, n_con), metrics=metrics,
-            tracer=tracer,
+            client, TARGET, k=k_rung, metrics=metrics,
+            tracer=tracer, attributor=attributor,
         )
         batcher = MicroBatcher(
             client, TARGET, window_ms=2.0, metrics=metrics,
@@ -1315,9 +1335,15 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
                 measured > 0
                 and abs(attributed - measured) <= 0.10 * measured
             )
+            touched = disp.touched_stats()
             rung = {
                 "constraints": n_con,
-                "partitions": min(k, n_con),
+                "partitions": k_rung,
+                # pruning telemetry: of the plan's partitions, how many
+                # a batch actually dispatched to (p50/max over the
+                # rung's replays)
+                "partitions_touched_p50": touched.get("p50"),
+                "partitions_touched_max": touched.get("max"),
                 "replay": {
                     key: r[key]
                     for key in ("requests", "throughput_rps",
@@ -1489,9 +1515,21 @@ def run_constraint_ladder(err=sys.stderr, rungs=LADDER, budget_s=None,
                 "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
             }
 
-            # fused micro-batching path, c=128
+            # fused micro-batching path, c=128 — partitioned with the
+            # cost/locality planner so mask-gated pruning is ON (the
+            # default fast path): each batch dispatches only the
+            # partitions its namespaces touch
+            from gatekeeper_tpu.parallel.partition import (
+                PartitionDispatcher,
+            )
+
             client = build_webhook_client(TpuDriver(), n_con)
-            batcher = MicroBatcher(client, TARGET, window_ms=2.0)
+            ladder_disp = PartitionDispatcher(
+                client, TARGET, k=min(n_con, max(4, n_con // 8), 64),
+            )
+            batcher = MicroBatcher(
+                client, TARGET, window_ms=2.0, partitioner=ladder_disp,
+            )
             handler = BatchedValidationHandler(batcher, request_timeout=60)
             batcher.start()
             try:
@@ -1515,6 +1553,9 @@ def run_constraint_ladder(err=sys.stderr, rungs=LADDER, budget_s=None,
                     k: r[k]
                     for k in ("requests", "throughput_rps", "p50_ms", "p99_ms")
                 }
+                rung["fused"]["partitions_touched"] = (
+                    ladder_disp.touched_stats()
+                )
                 if capture is not None:
                     _pex, fut = capture
                     rung["profile"] = fut.result(timeout=90)
@@ -1525,6 +1566,7 @@ def run_constraint_ladder(err=sys.stderr, rungs=LADDER, budget_s=None,
                     )
             finally:
                 batcher.stop()
+                ladder_disp.close()
 
             # native bridge stack, c=128 full HTTP
             if have_bridge:
@@ -1715,6 +1757,16 @@ def _summarize(mode, res):
             # dispatched/total constraint rows at every rung
             head["dispatch_efficiency"] = {
                 str(r["constraints"]): r.get("dispatch_efficiency")
+                for r in rungs
+            }
+            # partition touch counts per rung (the pruning width gauge
+            # next to the efficiency depth gauge)
+            head["partitions_touched_p50"] = {
+                str(r["constraints"]): r.get("partitions_touched_p50")
+                for r in rungs
+            }
+            head["partitions_touched_max"] = {
+                str(r["constraints"]): r.get("partitions_touched_max")
                 for r in rungs
             }
             if rungs:

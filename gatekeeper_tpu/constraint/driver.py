@@ -57,6 +57,19 @@ def constraint_key(constraint: Dict[str, Any]) -> str:
     return f"{constraint.get('kind', '?')}/{meta.get('name', '?')}"
 
 
+def _match_token(handler: TargetHandler, constraint: Dict[str, Any]) -> str:
+    """Canonical signature of a constraint's match block. Two constraints
+    with equal tokens match exactly the same reviews (matches_constraint
+    is a pure function of the match IR), so the token is both the
+    partition planner's locality group and the mask screen's dedup key."""
+    try:
+        return json.dumps(
+            handler.match_ir(constraint), sort_keys=True, default=str
+        )
+    except Exception:
+        return f"!opaque:{constraint_key(constraint)}"
+
+
 def _autoreject_result(constraint: Dict[str, Any], review: Any) -> Result:
     """The autoreject Result shape (client/regolib/src.go:7-21) — the ONE
     definition shared by every evaluation path (serial interpreter,
@@ -283,6 +296,29 @@ class RegoDriver(Driver):
         driver narrows it to actual constraint/template churn."""
         return self._data_version
 
+    def constraint_locality(self, target: str) -> Dict[str, str]:
+        """Match-locality token per constraint key. Constraints sharing
+        a token are satisfied by exactly the same reviews, so the
+        partition planner (parallel/partition.py build_plan) co-locates
+        them: a batch whose reviews hit one locality group then touches
+        one partition instead of all K."""
+        with self._mutex:
+            handler = self._handler(target)
+            return {
+                constraint_key(c): _match_token(handler, c)
+                for c in self._constraints(target)
+            }
+
+    def constraint_costs(self, target: str) -> Dict[str, float]:
+        """Relative per-constraint evaluation weight for the partition
+        planner's load balancing. The interpreter has no compiled
+        programs to size, so every constraint weighs the same; the TPU
+        driver overrides this with the compiled program's static cost."""
+        with self._mutex:
+            return {
+                constraint_key(c): 1.0 for c in self._constraints(target)
+            }
+
     def _ns_cache(self, target: str) -> Dict[str, Any]:
         """The target's review-context cache (K8s: synced Namespaces);
         resolution is the handler's, the storage accessor ours."""
@@ -345,9 +381,16 @@ class RegoDriver(Driver):
             handler = self._handler(target)
             constraints = self._constraints(target)
             ns_cache = self._ns_cache(target)
-            by_key: Dict[str, List[Dict[str, Any]]] = {}
+            # dedupe by match-block signature: a corpus stamped from a
+            # few templates shares match blocks across hundreds of
+            # constraints, so the screen costs O(distinct-blocks x batch)
+            # instead of O(constraints x batch)
+            key_toks: Dict[str, set] = {}
+            rep: Dict[str, Dict[str, Any]] = {}
             for c in constraints:
-                by_key.setdefault(constraint_key(c), []).append(c)
+                tok = _match_token(handler, c)
+                key_toks.setdefault(constraint_key(c), set()).add(tok)
+                rep.setdefault(tok, c)
             reviews = [
                 H.hook_get_default(i or {}, "review", {}) for i in inputs
             ]
@@ -356,19 +399,27 @@ class RegoDriver(Driver):
                 and handler.review_autorejects(r, ns_cache)
                 for r in reviews
             ]
+            tok_hits = {
+                tok: [
+                    handler.matches_constraint(c, r, ns_cache)
+                    for r in reviews
+                ]
+                for tok, c in rep.items()
+            }
+            tok_needs = {
+                tok: handler.constraint_needs_context(c)
+                for tok, c in rep.items()
+            }
             masks: List[List[bool]] = []
             for subset in subsets:
-                sub = [c for k in sorted(subset) for c in by_key.get(k, ())]
-                needs_ctx = any(
-                    handler.constraint_needs_context(c) for c in sub
-                )
+                toks = {
+                    t for k in subset for t in key_toks.get(k, ())
+                }
+                needs_ctx = any(tok_needs[t] for t in toks)
+                hits = [tok_hits[t] for t in toks]
                 masks.append([
-                    (ar and needs_ctx)
-                    or any(
-                        handler.matches_constraint(c, r, ns_cache)
-                        for c in sub
-                    )
-                    for r, ar in zip(reviews, autorej)
+                    (ar and needs_ctx) or any(h[i] for h in hits)
+                    for i, ar in enumerate(autorej)
                 ])
             return masks
 

@@ -102,6 +102,17 @@ MIN_DEVICE_BATCH = int(
     _os.environ.get("GATEKEEPER_TPU_MIN_DEVICE_BATCH", "12")
 )
 
+# Pair-aware floor for SUBSET dispatches (pruned partitions): a mask-
+# sliced sub-batch is small in reviews but DENSE in (review, constraint)
+# pairs — the locality planner co-locates exactly the constraints those
+# reviews match — so row count alone mis-routes it to the serial
+# interpreter, which then pays every pair at interpreter cost. A subset
+# batch below MIN_DEVICE_BATCH rows still takes the device when its
+# review x constraint pair volume clears this floor.
+MIN_DEVICE_PAIRS = int(
+    _os.environ.get("GATEKEEPER_TPU_MIN_DEVICE_PAIRS", "256")
+)
+
 
 def _params_key(params: Any) -> str:
     return json.dumps(params, sort_keys=True, default=str)
@@ -629,6 +640,21 @@ class TpuDriver(RegoDriver):
 
     def constraint_generation(self) -> int:
         return self._constraint_gen
+
+    def constraint_costs(self, target: str) -> Dict[str, float]:
+        """Static-cost planner weights: the compiled program's analyzer
+        cost (see _static_cost) per constraint key. Lazily compiles via
+        the shared `_programs` cache, so a warm driver pays nothing and
+        a cold one pays the compile it would pay on first dispatch
+        anyway. The partition planner blends these with measured
+        attributor seconds when available."""
+        with self._mutex:
+            return {
+                constraint_key(c): self._static_cost(
+                    self._program_for(target, c)
+                )
+                for c in self._constraints(target)
+            }
 
     def _subset_cset(
         self, target: str, subset: frozenset
@@ -1401,10 +1427,16 @@ class TpuDriver(RegoDriver):
                 return [
                     Response(target=target, results=[]) for _ in inputs
                 ]
-            if self.use_jax and len(inputs) < MIN_DEVICE_BATCH:
-                # adaptive routing, same floor as query_many: a tiny
-                # batch finishes faster on the serial interpreter than
-                # a device round trip would take
+            if (
+                self.use_jax
+                and len(inputs) < MIN_DEVICE_BATCH
+                and len(inputs) * len(cs.constraints) < MIN_DEVICE_PAIRS
+            ):
+                # adaptive routing, pair-aware (MIN_DEVICE_PAIRS): a
+                # tiny SPARSE batch finishes faster on the serial
+                # interpreter than a device round trip would take, but
+                # a mask-sliced sub-batch is dense — few reviews, many
+                # matching constraints — and belongs on the device
                 return [
                     Response(
                         target=target,

@@ -500,6 +500,8 @@ class Runner:
                 partitions=self.partitions or None,
                 recorder=self.recorder,
                 decision_log=self.decisions,
+                attributor=self.attributor,
+                replica=self.pod_name,
             )
             # postmortem state sources: what a flight record snapshots
             # alongside the trace tail / cost table / fault points
@@ -953,6 +955,23 @@ class Runner:
                         )
                     ).encode()
                     self.send_response(200)
+                elif self.path == "/debug/partitions":
+                    # live plan composition: per-partition constraint
+                    # keys, static/measured cost share, home device
+                    # (docs/robustness.md §Fault domains)
+                    part = getattr(
+                        runner.webhook, "partitioner", None
+                    )
+                    if part is not None:
+                        payload = json.dumps(
+                            part.plan_table()
+                        ).encode()
+                        self.send_response(200)
+                    else:
+                        payload = (
+                            b'{"error": "partitions disabled"}'
+                        )
+                        self.send_response(404)
                 elif self.path == "/debug/flightrecords":
                     # trip-triggered postmortem captures, newest first
                     # (docs/observability.md §Flight recorder)

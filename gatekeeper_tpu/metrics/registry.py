@@ -409,6 +409,7 @@ def serve_metrics(
     attributor=None,
     recorder=None,
     decisions=None,
+    partitions=None,
 ) -> ThreadingHTTPServer:
     """Serve /metrics (Prometheus text) on a background thread; returns
     the server (server_address[1] carries the bound port). The reference
@@ -417,8 +418,10 @@ def serve_metrics(
     With a tracer, /debug/traces serves the trace ring (?trace_id= /
     ?limit= / ?format=otlp — docs/observability.md); an attributor adds
     /debug/costs (the top-K cost table), a flight recorder adds
-    /debug/flightrecords, and a decision log adds /debug/decisions —
-    the same debug surface the health plane serves."""
+    /debug/flightrecords, a decision log adds /debug/decisions, and a
+    partition dispatcher adds /debug/partitions (the live cost/locality
+    plan composition) — the same debug surface the health plane
+    serves."""
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802
@@ -444,6 +447,9 @@ def serve_metrics(
                     if "format=ndjson" in self.path
                     else "application/json"
                 )
+            elif partitions is not None and route == "/debug/partitions":
+                payload = json.dumps(partitions.plan_table()).encode()
+                ctype = "application/json"
             else:
                 payload = b'{"error": "not found"}'
                 self.send_response(404)

@@ -98,6 +98,66 @@ class PartitionPlan:
         }
 
 
+def _guided_split(
+    keys: Sequence[str],
+    k_eff: int,
+    costs: Dict[str, float],
+    locality: Dict[str, str],
+    order: Dict[str, int],
+) -> List[Tuple[str, ...]]:
+    """Cost/locality-guided corpus split. Keys sharing a locality token
+    (constraints whose match blocks are identical — they fire on exactly
+    the same reviews) are co-located so a batch with namespace/kind
+    affinity touches 1-2 hot partitions instead of all K; groups are
+    packed into K bins by greedy LPT on cost (measured device seconds
+    when available, static compile cost otherwise). Deterministic: ties
+    break on global key order, so same inputs always give the same plan.
+    """
+    grouped: Dict[str, List[str]] = {}
+    for key in keys:
+        # a key without a locality token is its own group (no false
+        # co-location); "!key:" cannot collide with JSON match tokens
+        grouped.setdefault(
+            locality.get(key, f"!key:{key}"), []
+        ).append(key)
+
+    def g_cost(gkeys: List[str]) -> float:
+        return max(sum(costs.get(k2, 1.0) for k2 in gkeys), 1e-9)
+
+    def g_first(gkeys: List[str]) -> int:
+        return min(order[k2] for k2 in gkeys)
+
+    groups: List[List[str]] = sorted(
+        grouped.values(), key=g_first
+    )
+    # fewer locality groups than partitions: split the costliest
+    # multi-key group (alternating keys, preserving internal balance)
+    # until every partition slot has a group — degenerates to ~round-
+    # robin when the whole corpus shares one match block
+    while len(groups) < k_eff:
+        cand = max(
+            (g for g in groups if len(g) > 1),
+            key=lambda g: (g_cost(g), -g_first(g)),
+            default=None,
+        )
+        if cand is None:
+            break
+        groups.remove(cand)
+        groups.extend([cand[0::2], cand[1::2]])
+    # greedy LPT: heaviest group first onto the lightest bin
+    bins: List[List[str]] = [[] for _ in range(k_eff)]
+    loads = [0.0] * k_eff
+    for g in sorted(groups, key=lambda g: (-g_cost(g), g_first(g))):
+        i = min(range(k_eff), key=lambda j: (loads[j], j))
+        bins[i].extend(g)
+        loads[i] += g_cost(g)
+    bins = [b for b in bins if b]
+    bins.sort(key=lambda b: min(order[k2] for k2 in b))
+    return [
+        tuple(sorted(b, key=lambda k2: order[k2])) for b in bins
+    ]
+
+
 def build_plan(
     keys: Sequence[str],
     k: int,
@@ -105,20 +165,31 @@ def build_plan(
     healthy: frozenset,
     constraint_gen: Any = None,
     generation: int = 0,
+    costs: Optional[Dict[str, float]] = None,
+    locality: Optional[Dict[str, str]] = None,
 ) -> PartitionPlan:
-    """Deterministic plan: partition p takes every k-th key of the
-    sorted identity list (`keys[p::k]` — balanced within one constraint
-    and rebalanced by construction on churn) and homes on
-    `devices[p % len(devices)]`. A partition whose home device is not
-    healthy re-homes onto the healthy device chosen round-robin by
-    partition index — same inputs, same plan, always."""
+    """Deterministic plan. Without planner inputs, partition p takes
+    every k-th key of the sorted identity list (`keys[p::k]` — balanced
+    within one constraint and rebalanced by construction on churn).
+    With `costs`/`locality` (the dispatcher supplies both from the
+    driver + CostAttributor), the split is cost/locality-guided instead
+    (_guided_split) so mask-gated pruning can skip cold partitions.
+    Either way a partition homes on `devices[p % len(devices)]`; a
+    partition whose home device is not healthy re-homes onto the
+    healthy device chosen round-robin by partition index — same inputs,
+    same plan, always."""
     keys = list(keys)
     order = {key: i for i, key in enumerate(keys)}
     k_eff = min(max(1, int(k)), len(keys)) if keys else 0
     healthy_list = sorted(d for d in devices if d in healthy)
+    if (costs is None and locality is None) or not k_eff:
+        key_sets = [tuple(keys[p::k_eff]) for p in range(k_eff)]
+    else:
+        key_sets = _guided_split(
+            keys, k_eff, costs or {}, locality or {}, order
+        )
     partitions: List[Partition] = []
-    for p in range(k_eff):
-        pkeys = tuple(keys[p::k_eff])
+    for p, pkeys in enumerate(key_sets):
         home = devices[p % len(devices)]
         if home in healthy:
             device = home
@@ -143,6 +214,35 @@ def build_plan(
         devices=tuple(devices),
         all_dead=not healthy_list,
     )
+
+
+def _blend_costs(
+    keys: Sequence[str],
+    static: Optional[Dict[str, float]],
+    measured: Optional[Dict[str, float]],
+) -> Optional[Dict[str, float]]:
+    """Planner cost blend: measured per-constraint device seconds (the
+    CostAttributor's table) win where available; constraints without a
+    measurement fall back to static compile cost, rescaled so the two
+    populations are comparable (static mean matched to measured mean).
+    None when neither source has anything — build_plan then stays
+    round-robin."""
+    if not static and not measured:
+        return None
+    static = static or {}
+    pos = {
+        k: v for k, v in (measured or {}).items() if v > 0.0
+    }
+    if not pos:
+        return dict(static) or None
+    m_mean = sum(pos.values()) / len(pos)
+    s_vals = [static.get(k, 1.0) for k in pos]
+    s_mean = (sum(s_vals) / len(s_vals)) or 1.0
+    scale = m_mean / s_mean
+    return {
+        key: pos[key] if key in pos else static.get(key, 1.0) * scale
+        for key in keys
+    }
 
 
 def merge_partition_results(
@@ -204,6 +304,11 @@ class PartitionDispatcher:
         # obs.FlightRecorder: per-device breaker OPENs and operator
         # quarantines trip a postmortem capture (docs/observability.md)
         recorder=None,
+        # obs.CostAttributor: measured per-constraint device seconds
+        # feed the cost/locality planner (and /debug/partitions shares)
+        attributor=None,
+        # replica name stamped on /debug/partitions, like /debug/costs
+        replica: Optional[str] = None,
     ):
         self.client = client
         self.target = target
@@ -226,7 +331,11 @@ class PartitionDispatcher:
         self._clock = clock
         self._breaker_listener = breaker_listener
         self.recorder = recorder
+        self.attributor = attributor
+        self.replica = replica
         self._lock = threading.RLock()
+        self._touched: List[int] = []  # per-batch partitions touched
+        self._plan_costs: Dict[str, Dict[str, float]] = {}
         self._breakers: Dict[int, CircuitBreaker] = {}
         self._manual_quarantine: set = set()
         self._plan: Optional[PartitionPlan] = None
@@ -360,12 +469,20 @@ class PartitionDispatcher:
             with self._lock:
                 self._plan, self._plan_key = None, key
             return None
+        static, locality = self._planner_inputs(driver)
+        measured = self._measured_costs()
+        blended = _blend_costs(keys, static, measured)
         with self._lock:
             self._plan_gen += 1
             plan = build_plan(
                 keys, self.k, self.devices, healthy,
                 constraint_gen=gen, generation=self._plan_gen,
+                costs=blended, locality=locality,
             )
+            self._plan_costs = {
+                "static": dict(static or {}),
+                "measured": dict(measured),
+            }
             prev = self._plan
             if prev is not None:
                 moved = sum(
@@ -388,6 +505,40 @@ class PartitionDispatcher:
             )
         self._export_quarantine()
         return plan
+
+    def _planner_inputs(self, driver):
+        """Static costs + locality tokens from the driver's planner
+        surface (None-safe: a driver without the surface plans round-
+        robin exactly as before)."""
+        static = locality = None
+        fn = getattr(driver, "constraint_costs", None)
+        if fn is not None:
+            try:
+                static = fn(self.target)
+            except Exception:
+                static = None
+        fn = getattr(driver, "constraint_locality", None)
+        if fn is not None:
+            try:
+                locality = fn(self.target)
+            except Exception:
+                locality = None
+        return static, locality
+
+    def _measured_costs(self) -> Dict[str, float]:
+        """Measured per-constraint device seconds from the attributor,
+        keyed `<kind>/<name>` — the plan's empirical load signal."""
+        if self.attributor is None:
+            return {}
+        try:
+            doc = self.attributor.table(None)
+            return {
+                f"{r.get('kind', '?')}/{r.get('name', '?')}":
+                    float(r.get("seconds", 0.0))
+                for r in doc.get("rows", ())
+            }
+        except Exception:
+            return {}
 
     # -- restage (quarantine re-home) ------------------------------------------
 
@@ -493,6 +644,35 @@ class PartitionDispatcher:
                 device="" if device is None else str(device),
             )
 
+    def note_batch_touched(self, touched: int, planned: int) -> None:
+        """Pruning telemetry: of `planned` partitions in the live plan,
+        this batch dispatched work to `touched` (the rest were mask-
+        skipped — no device call, no restage touch)."""
+        with self._lock:
+            self._touched.append(int(touched))
+            if len(self._touched) > 4096:
+                del self._touched[: len(self._touched) // 2]
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "device_partitions_touched", touched, plane=self.plane,
+            )
+            self.metrics.gauge(
+                "device_partitions_planned", planned, plane=self.plane,
+            )
+
+    def touched_stats(self) -> Dict[str, Any]:
+        """p50/max of per-batch partitions touched (bench SUMMARY's
+        partitions_touched_p50/_max; window = last ~4k batches)."""
+        with self._lock:
+            data = sorted(self._touched)
+        if not data:
+            return {"batches": 0, "p50": None, "max": None}
+        return {
+            "batches": len(data),
+            "p50": data[len(data) // 2],
+            "max": data[-1],
+        }
+
     @property
     def executor(self) -> Optional[ThreadPoolExecutor]:
         """Shared pool for concurrent partition dispatches (the driver
@@ -540,6 +720,48 @@ class PartitionDispatcher:
             })
         return snap
 
+    def plan_table(self) -> Dict[str, Any]:
+        """/debug/partitions: live plan composition — per-partition
+        constraint keys, static/measured cost share, home + current
+        device — replica-tagged like /debug/costs. Refreshes the plan
+        first so the table reflects current churn/health."""
+        try:
+            plan = self.plan()
+        except Exception:
+            with self._lock:
+                plan = self._plan
+        with self._lock:
+            static = dict(self._plan_costs.get("static", {}))
+            measured = dict(self._plan_costs.get("measured", {}))
+        s_total = sum(static.values())
+        m_total = sum(v for v in measured.values() if v > 0.0)
+        doc: Dict[str, Any] = {
+            "plane": self.plane,
+            "k": self.k,
+            "generation": plan.generation if plan is not None else None,
+            "all_dead": plan.all_dead if plan is not None else None,
+            "partitions_touched": self.touched_stats(),
+            "partitions": [],
+        }
+        if self.replica:
+            doc["replica"] = self.replica
+        if plan is not None:
+            for p in plan.partitions:
+                s = sum(static.get(k, 0.0) for k in p.keys)
+                m = sum(measured.get(k, 0.0) for k in p.keys)
+                doc["partitions"].append({
+                    "index": p.index,
+                    "home_device": p.home_device,
+                    "device": p.device,
+                    "constraints": len(p.keys),
+                    "keys": list(p.keys),
+                    "static_cost_share":
+                        (s / s_total) if s_total > 0 else None,
+                    "measured_cost_share":
+                        (m / m_total) if m_total > 0 else None,
+                })
+        return doc
+
     def snapshot(self) -> Dict[str, Any]:
         """Readyz/debug view: the plan, quarantine state, per-device
         breaker snapshots (keyed by breaker NAME), and dispatch/rehome/
@@ -560,6 +782,7 @@ class PartitionDispatcher:
                     for b in self._breakers.values()
                 },
                 "dispatches": dict(self.dispatches),
+                "partitions_touched": self.touched_stats(),
                 "rehomes": self.rehomes,
                 "probes": self.probes,
                 "restage_failures": self.restage_failures,

@@ -201,6 +201,9 @@ def main(argv=None) -> int:
                 attributor=runner.attributor,
                 recorder=runner.recorder,
                 decisions=runner.decisions,
+                partitions=getattr(
+                    runner.webhook, "partitioner", None
+                ),
             )
             log.info(
                 "metrics serving", prometheus_port=args.prometheus_port

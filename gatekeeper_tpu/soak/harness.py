@@ -245,6 +245,9 @@ class SoakHarness:
         self.events_log: List[Dict[str, Any]] = []
         self._window_samples: List[Dict[str, Any]] = []
         self._churn_n = itertools.count(1)
+        # locality-skewed traffic: (hot_ns, cold_ns, skew) once a
+        # locality_churn event fires; None = the uniform ns{i%7} mix
+        self._locality: Optional[tuple] = None
         self._req_n = itertools.count()
         self._rr = itertools.count()  # LB round-robin cursor
         self._t0 = time.monotonic()  # re-stamped at load start
@@ -506,7 +509,17 @@ class SoakHarness:
     # -- request bodies -------------------------------------------------------
 
     def _pod_request(self, i: int, violating: bool) -> Dict[str, Any]:
-        return _pod_request(i, violating, self.scenario.external_keys)
+        req = _pod_request(i, violating, self.scenario.external_keys)
+        loc = self._locality
+        if loc is not None:
+            # deterministic 90/10 (skew) namespace split: the hot
+            # group's partitions stay hot, the cold group's sit mask-
+            # skipped for most batches
+            hot, cold, skew = loc
+            ns = hot if (i % 100) < int(round(skew * 100)) else cold
+            req["namespace"] = ns
+            req["object"]["metadata"]["namespace"] = ns
+        return req
 
     def _body(self, plane: str) -> bytes:
         i = next(self._req_n)
@@ -603,6 +616,26 @@ class SoakHarness:
                         "SoakPrivileged", f"churn{stamp}-{j}",
                         match=_POD_MATCH,
                     ))
+        elif action == "locality_churn":
+            # two namespace-affine constraint groups: identical match
+            # blocks within a group give one locality token each, so
+            # the guided planner co-locates them — and the traffic
+            # skew applied in _pod_request makes one group hot while
+            # the other's partitions sit mask-skipped
+            count = int(params.get("count", 10))
+            hot = str(params.get("hot_ns", "ns-aff-hot"))
+            cold = str(params.get("cold_ns", "ns-aff-cold"))
+            skew = float(params.get("skew", 0.9))
+            stamp = next(self._churn_n)
+            for rep in self.replicas:
+                for ns in (hot, cold):
+                    for j in range(count):
+                        rep.client.add_constraint(_constraint(
+                            "SoakPrivileged",
+                            f"aff{stamp}-{ns}-{j}",
+                            match={**_POD_MATCH, "namespaces": [ns]},
+                        ))
+            self._locality = (hot, cold, skew)
         elif action == "add_template":
             n = next(self._churn_n)
             kind = f"SoakChurn{n}"
@@ -740,6 +773,7 @@ class SoakHarness:
         cert_gen = metrics_dropped = 0
         dec_recorded = dec_dropped = dec_sampled = dec_ring = 0
         dec_routes: Dict[str, int] = {}
+        pt_p50 = pt_max = None  # pruned-dispatch width across replicas
         for rep in self.replicas:
             for b in (
                 rep.server.batcher,
@@ -778,6 +812,20 @@ class SoakHarness:
                 dec_ring += dsnap["retained"]
                 for route, n in dsnap["routes"].items():
                     dec_routes[route] = dec_routes.get(route, 0) + n
+            if rep.partitioner is not None:
+                # pruning width (mask-gated partition skipping): p50/
+                # max partitions touched per batch over the recent
+                # window — the locality_skew phase's evidence series
+                st = rep.partitioner.touched_stats()
+                if st["p50"] is not None:
+                    pt_p50 = (
+                        st["p50"] if pt_p50 is None
+                        else max(pt_p50, st["p50"])
+                    )
+                    pt_max = (
+                        st["max"] if pt_max is None
+                        else max(pt_max, st["max"])
+                    )
         return {
             "shed_cum": shed,
             "batch_failures_cum": failures,
@@ -796,6 +844,8 @@ class SoakHarness:
             "decisions_sampled_out_cum": dec_sampled,
             "decision_ring": dec_ring,
             "decision_routes_cum": dec_routes,
+            "partitions_touched_p50": pt_p50,
+            "partitions_touched_max": pt_max,
         }
 
     def _sampler_loop(self) -> None:
@@ -847,6 +897,14 @@ class SoakHarness:
                     route: n - prev["decision_routes_cum"].get(route, 0)
                     for route, n in cur["decision_routes_cum"].items()
                 },
+                # pruning width at this window's close (running p50/
+                # max over the dispatcher's recent-batch window)
+                "partitions_touched_p50": (
+                    cur["partitions_touched_p50"]
+                ),
+                "partitions_touched_max": (
+                    cur["partitions_touched_max"]
+                ),
             })
             prev = cur
             # per-window SLO-breach detector: a window whose failure

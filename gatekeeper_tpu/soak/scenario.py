@@ -53,6 +53,13 @@ ACTIONS: Dict[str, tuple] = {
     "add_template": (),          # new template kind + one constraint
     "add_provider": (),          # register another stub-backed provider
     "add_mutator": (),           # add an AssignMetadata mutator
+    # locality-skewed churn (pruned dispatch): add two namespace-
+    # affine constraint groups (count per group; hot_ns/cold_ns name
+    # the namespaces) and skew subsequent traffic toward the hot one
+    # (skew, default 0.9) — the guided planner co-locates each group,
+    # so sampler windows show partitions_touched well under the plan's
+    # k while the cold group's partitions sit mask-skipped
+    "locality_churn": (),
     "arm_fault": ("point",),     # mode/count/after/delay ride along
     "disarm_faults": (),         # reset the whole fault registry
     "rotate_certs": (),          # force a cert rotation (tls only)
@@ -273,7 +280,10 @@ def default_scenario() -> Scenario:
     """The full evidence run behind SOAK_r01.json: two TLS replicas
     sharing a fleet cert Secret and cache/breaker gossip, >= 60 s of
     steady open-loop load for the leak curves, then churn
-    (constraints + template + provider + mutator adds), a fault window
+    (constraints + template + provider + mutator adds, capped by a
+    locality-skewed window: two namespace-affine constraint groups
+    with 90/10 traffic skew — the pruned-dispatch evidence), a fault
+    window
     (device faults trip the breaker while the host rung stalls — the
     SLO must degrade and then recover post-disarm), a sick-chip window
     (ONE device of the 4-partition plan faulted: only its constraint
@@ -312,6 +322,15 @@ def default_scenario() -> Scenario:
             {"at": 66.0, "action": "add_template"},
             {"at": 70.0, "action": "add_provider"},
             {"at": 74.0, "action": "add_mutator"},
+            # locality-skewed churn: two namespace-affine constraint
+            # groups join the corpus and 90% of subsequent traffic
+            # lands on the hot namespace — the guided plan co-locates
+            # each group, so this phase's sampler windows record
+            # partitions_touched falling under the plan's k (the
+            # pruned-dispatch evidence window)
+            {"at": 76.0, "action": "phase", "name": "locality_skew"},
+            {"at": 76.5, "action": "locality_churn", "count": 10,
+             "skew": 0.9},
             {"at": 85.0, "action": "phase", "name": "fault"},
             {"at": 86.0, "action": "arm_fault",
              "point": "driver.device_dispatch", "mode": "error"},

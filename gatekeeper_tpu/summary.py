@@ -46,6 +46,7 @@ REQUIRED_FIELDS: Dict[str, tuple] = {
     "ladder": ("rungs", "last"),
     "attribution": (
         "rungs", "sums_ok", "attribution_ratio", "dispatch_efficiency",
+        "partitions_touched_p50", "partitions_touched_max",
     ),
     "partitions": (
         "parity_ok", "healthy_subset_degraded",

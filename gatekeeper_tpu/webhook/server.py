@@ -594,11 +594,23 @@ class MicroBatcher:
             else:
                 fused.append((p, br))
 
+        # mask-sliced dispatch: each partition evaluates ONLY the
+        # requests its mask row selects — the requests that can produce
+        # a result from its subset. Unselected rows contribute zero
+        # results by the mask's definition, so padding them back as
+        # empty lists keeps merged verdicts bit-identical to the
+        # monolith while rows_dispatched drops to the matched cells.
+        sel_by_part = {
+            p.index: [i for i, hit in enumerate(masks[p.index]) if hit]
+            for p in plan.partitions
+        }
+
         def run_one(p, br):
+            sel = sel_by_part[p.index]
             try:
                 return p, br, client.review_many_subset(
-                    reviews, p.subset, device=p.device,
-                    partition=p.index,
+                    [reviews[i] for i in sel], p.subset,
+                    device=p.device, partition=p.index,
                 ), None
             except Exception as e:
                 return p, br, None, e
@@ -614,10 +626,10 @@ class MicroBatcher:
             if exc is None:
                 br.record_success()
                 part.note_dispatch("fused", p.device)
-                rows: List[List[Any]] = []
-                for responses in resps:
+                rows: List[List[Any]] = [[] for _ in reviews]
+                for i, responses in zip(sel_by_part[p.index], resps):
                     resp = responses.by_target.get(self.target)
-                    rows.append(resp.results if resp is not None else [])
+                    rows[i] = resp.results if resp is not None else []
                 part_results[p.index] = rows
             else:
                 br.record_failure()
@@ -675,23 +687,25 @@ class MicroBatcher:
                         plane=self.plane, partitions=sorted(pidx),
                     )
         # dispatch-explain facts (docs/observability.md §Decision log):
-        # per-partition pruning-efficiency series — a fused partition
-        # evaluated the whole batch, a host partition only its masked
-        # requests, a mask-skipped partition nothing — plus the
-        # per-request partition set and mask-derived rows
+        # per-partition pruning-efficiency series — fused and host
+        # partitions both evaluate only their mask-selected requests, a
+        # mask-skipped partition nothing — plus the per-request
+        # partition set and mask-derived rows
         host_idx = {p.index for p in host_parts}
         n_rev = len(reviews)
         key_count = {p.index: len(p.keys) for p in plan.partitions}
         corpus_rows = sum(key_count.values())
+        touched = len(plan.partitions) - len(skipped_parts)
+        note_touched = getattr(part, "note_batch_touched", None)
+        if note_touched is not None:
+            note_touched(touched, len(plan.partitions))
         for p, mask in zip(plan.partitions, masks):
             if p.index in skipped_parts:
                 dispatched = 0
-            elif p.index in host_idx:
-                dispatched = key_count[p.index] * sum(
-                    1 for hit in mask if hit
-                )
             else:
-                dispatched = key_count[p.index] * n_rev
+                dispatched = key_count[p.index] * len(
+                    sel_by_part[p.index]
+                )
             self._note_rows(
                 p.index, dispatched, key_count[p.index] * n_rev
             )
@@ -706,10 +720,11 @@ class MicroBatcher:
                 facts: Dict[str, Any] = {
                     "partitions_matched": matched,
                     "partitions_skipped": list(skipped_parts),
+                    "partitions_touched": touched,
                     "rows_total": corpus_rows,
-                    # the mask-derived per-request rows: constraint
-                    # rows whose partitions this request actually
-                    # touches (what pruned dispatch would pay)
+                    # the per-request rows pruned dispatch pays:
+                    # constraint rows of the partitions this request's
+                    # mask actually selects
                     "rows_dispatched": sum(
                         key_count[j] for j in matched
                     ),
@@ -947,6 +962,12 @@ class WebhookServer:
         # None = decision plane off (docs/observability.md §Decision
         # log; bench_webhook --attribution measures the on/off delta)
         decision_log=None,
+        # obs.CostAttributor: measured per-constraint device seconds
+        # feed the partition planner (cost/locality-guided plan builds
+        # instead of round-robin); replica tags /debug/partitions the
+        # way /debug/costs is tagged
+        attributor=None,
+        replica: Optional[str] = None,
     ):
         self.client = client  # warmup() compiles through it
         self.tracer = tracer
@@ -967,6 +988,8 @@ class WebhookServer:
                 metrics=metrics,
                 tracer=tracer,
                 recorder=recorder,
+                attributor=attributor,
+                replica=replica,
             )
         # graceful-drain state: `draining` flips BEFORE the listener
         # closes (readiness consults it), in-flight HTTP requests are

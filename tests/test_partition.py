@@ -474,3 +474,442 @@ def test_all_dead_plan_flag():
     disp.heal(0)
     assert not disp.plan().all_dead
     disp.close()
+
+
+# -- batch-aware pruned dispatch (mask-gated skipping + guided planner) -------
+#
+# The `pruning` marker's home: parity of the mask-sliced fast path
+# against the monolith across skip combinations (none / some / all
+# partitions skipped, autoreject + needs-context rows, G_CAP-overflow
+# rows), the decision-log facts a skipped partition must report, and
+# the cost/locality-guided planner's co-location + balance contract —
+# all on the numpy driver, tier-1 safe (no device, no jit).
+
+pruned = pytest.mark.pruning
+
+AFFINE_NAMESPACES = ("ns-hot", "ns-cold")
+
+
+def counter(metrics, name, **tags):
+    snap = metrics.snapshot()["counters"]
+    total = 0
+    for key, v in snap.items():
+        if not key.startswith(name):
+            continue
+        if all(f'{k}="{val}"' in key for k, val in tags.items()):
+            total += v
+    return total
+
+
+def build_affine_client(n_per_ns=3):
+    """Namespace-affine corpus: `n_per_ns` required-labels constraints
+    per namespace group (identical match blocks within a group -> one
+    locality token each -> the guided planner co-locates them), plus
+    one needs-context constraint (namespaceSelector -> autoreject on
+    uncached namespaces) in its own locality group."""
+    cl = Backend(TpuDriver(use_jax=False)).new_client(K8sValidationTarget())
+    cl.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "affreq"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "AffReq"}}},
+            "targets": [{
+                "target": TARGET,
+                "rego": V_REGO.replace("partreq", "affreq"),
+            }],
+        },
+    })
+    for ns in AFFINE_NAMESPACES:
+        for i in range(n_per_ns):
+            cl.add_constraint({
+                "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": "AffReq",
+                "metadata": {"name": f"req-{ns}-{i}"},
+                "spec": {
+                    "match": {
+                        "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+                        "namespaces": [ns],
+                    },
+                    "parameters": {"labels": ["owner"]},
+                },
+            })
+    cl.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "AffReq",
+        "metadata": {"name": "req-nssel"},
+        "spec": {
+            "match": {
+                "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+                "namespaceSelector": {"matchLabels": {"team": "core"}},
+            },
+            "parameters": {"labels": ["owner"]},
+        },
+    })
+    return cl
+
+
+def affine_request(i, ns):
+    """battery_request shape variety scoped to one namespace: labeled/
+    unlabeled rows plus a G_CAP-overflow pod (70 containers -> per-row
+    interpreter route) every 4th request."""
+    req = battery_request(i)
+    if i % 4 == 3:
+        req["object"]["spec"] = {"containers": [
+            {"name": f"c{j}", "image": "nginx"} for j in range(70)
+        ]}
+    req["namespace"] = ns
+    req["object"]["metadata"]["namespace"] = ns
+    return req
+
+
+def dispatch_pruned_batch(batcher, requests, ctxs=None):
+    """Drive ONE batch through MicroBatcher._dispatch (the partitioned
+    fast path when a partitioner is attached) and return each request's
+    result list — deterministic, no worker-thread timing."""
+    import time as _time
+    from concurrent.futures import Future
+
+    stamp = (_time.time(), _time.perf_counter())
+    batch = [
+        (r, Future(), (ctxs[i] if ctxs else None), stamp, None)
+        for i, r in enumerate(requests)
+    ]
+    batcher._dispatch(batch)
+    return [item[1].result(timeout=30) for item in batch]
+
+
+@pruned
+@pytest.mark.parametrize("batch_ns,expect_skips", [
+    # all-hot traffic: the cold group's partition is mask-skipped
+    (["ns-hot"] * 6, True),
+    # mixed traffic touches both groups: nothing to skip
+    (["ns-hot", "ns-cold"] * 3, False),
+])
+def test_pruned_dispatch_parity_with_partition_skips(batch_ns,
+                                                     expect_skips):
+    """The tentpole contract: partitions whose mask row is empty are
+    not dispatched (no device call, rows_dispatched drops to zero) and
+    merged verdicts stay identical to the monolith — including
+    autoreject/needs-context rows and G_CAP-overflow rows."""
+    from gatekeeper_tpu.webhook.server import MicroBatcher
+
+    cl = build_affine_client()
+    metrics = MetricsRegistry()
+    disp = PartitionDispatcher(cl, TARGET, k=3, metrics=metrics)
+    batcher = MicroBatcher(
+        cl, TARGET, metrics=metrics, partitioner=disp,
+    )
+    requests = [affine_request(i, ns) for i, ns in enumerate(batch_ns)]
+    reviews = augmented(cl, requests)
+    mono = cl.review_many(reviews)
+    results = dispatch_pruned_batch(batcher, requests)
+
+    plan = disp.plan()
+    masks = cl.partition_match_mask(
+        reviews, [p.subset for p in plan.partitions]
+    )
+    skipped = {p.index for p in plan.partitions if not any(masks[p.index])}
+    touched = len(plan.partitions) - len(skipped)
+    assert bool(skipped) == expect_skips
+    some_results = False
+    for i in range(len(requests)):
+        expect = (
+            mono[i].by_target[TARGET].results
+            if TARGET in mono[i].by_target else []
+        )
+        assert normalize(results[i]) == normalize(expect), f"request {i}"
+        some_results = some_results or bool(expect)
+    assert some_results  # never vacuous
+
+    # telemetry: the batch touched exactly the non-skipped partitions
+    stats = disp.touched_stats()
+    assert stats["batches"] == 1
+    assert stats["p50"] == touched and stats["max"] == touched
+    # the pruning-efficiency series: a skipped partition dispatched
+    # ZERO rows; a touched one only its mask-selected rows
+    for p in plan.partitions:
+        d = counter(metrics, "dispatch_rows_dispatched_total",
+                    partition=str(p.index))
+        t = counter(metrics, "dispatch_rows_total",
+                    partition=str(p.index))
+        assert t == len(p.keys) * len(requests)
+        if p.index in skipped:
+            assert d == 0
+        else:
+            assert d == len(p.keys) * sum(masks[p.index])
+    # a skipped partition is counted as such, never as a device call
+    if skipped:
+        assert counter(metrics, "device_partition_dispatch_total",
+                       route="skipped") == len(skipped)
+    batcher.stop()
+    disp.close()
+
+
+@pruned
+def test_pruned_dispatch_all_partitions_skipped():
+    """A batch nothing in the corpus matches (and no autoreject path
+    selects) dispatches ZERO partitions — and still answers every
+    request, identically to the monolith (all-empty verdicts)."""
+    from gatekeeper_tpu.webhook.server import MicroBatcher
+
+    cl = build_affine_client()
+    # drop the needs-context constraint's autoreject path by serving
+    # cached namespace data: every namespace is known, unlabeled
+    ns_getter = lambda ns: {  # noqa: E731
+        "metadata": {"name": ns, "labels": {}}
+    }
+    metrics = MetricsRegistry()
+    disp = PartitionDispatcher(cl, TARGET, k=3, metrics=metrics)
+    batcher = MicroBatcher(
+        cl, TARGET, metrics=metrics, partitioner=disp,
+        namespace_getter=ns_getter,
+    )
+    requests = [affine_request(i, "ns-other") for i in range(4)]
+    handler = batcher.target_handler
+    reviews = [handler.augment_request(r, ns_getter) for r in requests]
+    mono = cl.review_many(reviews)
+    results = dispatch_pruned_batch(batcher, requests)
+    for i in range(len(requests)):
+        expect = (
+            mono[i].by_target[TARGET].results
+            if TARGET in mono[i].by_target else []
+        )
+        assert normalize(results[i]) == normalize(expect)
+        assert results[i] == []  # ns-other matches nothing
+    stats = disp.touched_stats()
+    assert stats["batches"] == 1 and stats["p50"] == 0
+    assert counter(metrics, "device_partition_dispatch_total",
+                   route="skipped") == len(disp.plan().partitions)
+    assert counter(metrics, "dispatch_rows_dispatched_total") == 0
+    assert counter(metrics, "dispatch_rows_total") > 0
+    batcher.stop()
+    disp.close()
+
+
+@pruned
+def test_pruned_dispatch_parity_battery_no_skips():
+    """The whole-corpus battery (VECTORIZED + PARTIAL_ROWS +
+    INTERPRETER + needs-context autorejects + overflow rows) through
+    the pruned path: every partition matches Pod traffic, so nothing
+    skips — and verdicts still merge identical to the monolith."""
+    from gatekeeper_tpu.webhook.server import MicroBatcher
+
+    cl = build_battery_client(9)
+    disp = PartitionDispatcher(cl, TARGET, k=4)
+    batcher = MicroBatcher(cl, TARGET, partitioner=disp)
+    requests = [battery_request(i) for i in range(23)]
+    reviews = augmented(cl, requests)
+    mono = cl.review_many(reviews)
+    results = dispatch_pruned_batch(batcher, requests)
+    some = False
+    for i in range(len(requests)):
+        expect = (
+            mono[i].by_target[TARGET].results
+            if TARGET in mono[i].by_target else []
+        )
+        assert normalize(results[i]) == normalize(expect), f"request {i}"
+        some = some or bool(expect)
+    assert some
+    stats = disp.touched_stats()
+    assert stats["p50"] == len(disp.plan().partitions)  # all touched
+    batcher.stop()
+    disp.close()
+
+
+@pruned
+def test_decision_facts_report_skipped_partitions_zero_rows():
+    """Decision-log fact check: a mask-skipped partition appears in
+    `partitions_skipped`, never in `partitions_matched`, and
+    contributes ZERO rows to the request's `rows_dispatched`."""
+    from types import SimpleNamespace
+
+    from gatekeeper_tpu.obs import DecisionLog
+    from gatekeeper_tpu.webhook.server import MicroBatcher
+
+    cl = build_affine_client()
+    log = DecisionLog(allow_sample_n=1, max_per_s=0)
+    disp = PartitionDispatcher(cl, TARGET, k=3)
+    batcher = MicroBatcher(cl, TARGET, partitioner=disp, decisions=log)
+    requests = [affine_request(i, "ns-hot") for i in range(4)]
+    ctxs = [SimpleNamespace(trace_id=f"{i:032x}") for i in range(4)]
+    reviews = augmented(cl, requests)
+    dispatch_pruned_batch(batcher, requests, ctxs)
+    plan = disp.plan()
+    masks = cl.partition_match_mask(
+        reviews, [p.subset for p in plan.partitions]
+    )
+    skipped = {p.index for p in plan.partitions if not any(masks[p.index])}
+    assert skipped  # all-hot traffic must leave the cold group cold
+    keycount = {p.index: len(p.keys) for p in plan.partitions}
+    for i, ctx in enumerate(ctxs):
+        rec = log.record_decision(
+            "validation", "deny", trace_id=ctx.trace_id
+        )
+        assert rec is not None
+        assert set(rec["partitions_skipped"]) == skipped
+        assert skipped.isdisjoint(rec["partitions_matched"])
+        assert rec["partitions_touched"] == (
+            len(plan.partitions) - len(skipped)
+        )
+        matched = [
+            p.index for p in plan.partitions if masks[p.index][i]
+        ]
+        assert rec["partitions_matched"] == matched
+        assert rec["rows_dispatched"] == sum(
+            keycount[j] for j in matched
+        )
+        assert rec["rows_total"] == sum(keycount.values())
+        # skipped partitions contribute zero dispatched rows
+        assert rec["rows_dispatched"] <= rec["rows_total"] - sum(
+            keycount[j] for j in skipped
+        )
+    batcher.stop()
+    disp.close()
+
+
+# -- the cost/locality-guided planner (tier-1 smoke, no device) --------------
+
+
+@pruned
+def test_guided_planner_colocates_and_balances_synthetic_costs():
+    """Planner smoke on a synthetic attribution table: keys sharing a
+    locality token land in ONE partition (hot-key co-location), and
+    greedy LPT keeps per-partition cost deterministic and balanced."""
+    from gatekeeper_tpu.parallel.partition import build_plan
+
+    keys = [f"K/c{i:02d}" for i in range(12)]
+    groups = {  # token -> member indices
+        "g-a": [0, 1, 2, 3], "g-b": [4, 5], "g-c": [6, 7],
+        "g-d": [8], "g-e": [9, 10], "g-f": [11],
+    }
+    locality = {
+        keys[i]: tok for tok, idxs in groups.items() for i in idxs
+    }
+    # measured device seconds: group costs 10, 9, 2, 2, 1, 1
+    per_group = {"g-a": 10.0, "g-b": 9.0, "g-c": 2.0,
+                 "g-d": 2.0, "g-e": 1.0, "g-f": 1.0}
+    costs = {
+        keys[i]: per_group[tok] / len(idxs)
+        for tok, idxs in groups.items() for i in idxs
+    }
+    plan = build_plan(
+        keys, 3, range(3), frozenset(range(3)),
+        costs=costs, locality=locality,
+    )
+    assert len(plan.partitions) == 3
+    # co-location: no locality group straddles partitions
+    home_of = {}
+    for p in plan.partitions:
+        for key in p.keys:
+            home_of.setdefault(locality[key], set()).add(p.index)
+    assert all(len(parts) == 1 for parts in home_of.values())
+    # LPT balance on the synthetic costs: loads are {10, 9, 6}
+    loads = sorted(
+        (sum(costs[key] for key in p.keys) for p in plan.partitions),
+        reverse=True,
+    )
+    assert [round(x) for x in loads] == [10, 9, 6]
+    # determinism: same inputs, same plan
+    again = build_plan(
+        keys, 3, range(3), frozenset(range(3)),
+        costs=costs, locality=locality,
+    )
+    assert [p.keys for p in again.partitions] == [
+        p.keys for p in plan.partitions
+    ]
+    # every key lands exactly once
+    seen = [key for p in plan.partitions for key in p.keys]
+    assert sorted(seen) == sorted(keys)
+
+
+@pruned
+def test_guided_planner_splits_one_hot_group_to_fill_partitions():
+    """One locality token across the whole corpus (every constraint
+    matches the same reviews): the planner degenerates to a balanced
+    split — mask-gating can't prune, but parallelism is preserved."""
+    from gatekeeper_tpu.parallel.partition import build_plan
+
+    keys = [f"K/c{i}" for i in range(8)]
+    locality = {key: "same" for key in keys}
+    plan = build_plan(
+        keys, 4, range(4), frozenset(range(4)),
+        costs={key: 1.0 for key in keys}, locality=locality,
+    )
+    sizes = sorted(len(p.keys) for p in plan.partitions)
+    assert len(plan.partitions) == 4
+    assert max(sizes) - min(sizes) <= 1
+    assert sorted(
+        key for p in plan.partitions for key in p.keys
+    ) == sorted(keys)
+
+
+@pruned
+def test_blend_costs_prefers_measured_and_rescales_static():
+    from gatekeeper_tpu.parallel.partition import _blend_costs
+
+    keys = ["K/a", "K/b", "K/c"]
+    assert _blend_costs(keys, None, None) is None
+    assert _blend_costs(keys, None, {}) is None
+    # static only passes through
+    static = {"K/a": 2.0, "K/b": 4.0, "K/c": 6.0}
+    assert _blend_costs(keys, static, {}) == static
+    # measured wins where present; unmeasured keys rescale so the two
+    # populations are comparable (static mean matched to measured mean)
+    blended = _blend_costs(keys, static, {"K/a": 0.5})
+    assert blended["K/a"] == 0.5
+    scale = 0.5 / 2.0  # measured mean over static mean of measured keys
+    assert blended["K/b"] == pytest.approx(4.0 * scale)
+    assert blended["K/c"] == pytest.approx(6.0 * scale)
+
+
+@pruned
+def test_dispatcher_plans_from_synthetic_attribution_table():
+    """End-to-end planner smoke: a fake attributor's measured table
+    steers the plan (hot constraints co-located by locality, measured
+    cost shares surfaced in /debug/partitions' plan_table) — no
+    device, tier-1 safe."""
+    cl = build_affine_client(n_per_ns=3)
+    keys = cl._driver.constraint_keys(TARGET)
+
+    class _FakeAttributor:
+        def table(self, k=None):
+            return {"rows": [
+                {"kind": key.split("/")[0], "name": key.split("/")[1],
+                 "seconds": 0.5 if "ns-hot" in key else 0.01}
+                for key in keys
+            ]}
+
+    disp = PartitionDispatcher(
+        cl, TARGET, k=3, attributor=_FakeAttributor(), replica="r7",
+    )
+    plan = disp.plan()
+    assert plan is not None and len(plan.partitions) == 3
+    # the hot namespace group is co-located in one partition
+    hot_parts = {
+        p.index for p in plan.partitions
+        for key in p.keys if "ns-hot" in key
+    }
+    cold_parts = {
+        p.index for p in plan.partitions
+        for key in p.keys if "ns-cold" in key
+    }
+    assert len(hot_parts) == 1 and len(cold_parts) == 1
+    assert hot_parts != cold_parts
+    table = disp.plan_table()
+    assert table["replica"] == "r7"
+    assert table["k"] == 3 and len(table["partitions"]) == 3
+    by_index = {row["index"]: row for row in table["partitions"]}
+    hot_row = by_index[next(iter(hot_parts))]
+    cold_row = by_index[next(iter(cold_parts))]
+    # measured share: the hot group dominates device seconds
+    assert hot_row["measured_cost_share"] > cold_row[
+        "measured_cost_share"
+    ]
+    assert hot_row["home_device"] is not None
+    assert set(hot_row["keys"]) == {
+        key for key in keys if "ns-hot" in key
+    }
+    # static share present too (every key has a static cost)
+    assert hot_row["static_cost_share"] is not None
+    disp.close()

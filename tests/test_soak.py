@@ -85,6 +85,43 @@ def test_scenario_rejects_unknown_keys():
         Scenario.from_dict({"duration_s": 10, "rps": 5, "nope": 1})
 
 
+def test_default_scenario_has_locality_skew_phase():
+    """The pruned-dispatch evidence window: default_scenario carries a
+    locality_skew phase whose locality_churn event adds two namespace-
+    affine constraint groups with 90/10 traffic skew."""
+    scn = default_scenario()
+    phases = [
+        e.params.get("name") for e in scn.events if e.action == "phase"
+    ]
+    assert "locality_skew" in phases
+    churn = [e for e in scn.events if e.action == "locality_churn"]
+    assert len(churn) == 1
+    assert churn[0].params.get("skew") == 0.9
+    # round-trips through the strict loader like every other action
+    Scenario.from_dict(scn.to_dict())
+
+
+def test_locality_churn_event_skews_request_namespaces():
+    """After a locality_churn event the harness's request stream lands
+    skew% of traffic on the hot namespace, deterministically — and the
+    namespace is consistent between the AdmissionRequest envelope and
+    the object metadata."""
+    from gatekeeper_tpu.soak.harness import SoakHarness
+
+    h = SoakHarness(smoke_scenario())
+    before = h._pod_request(3, False)["namespace"]
+    assert before == "ns3"  # uniform mix until the event fires
+    h._run_event("locality_churn", {"count": 2, "skew": 0.9})
+    reqs = [h._pod_request(i, False) for i in range(100)]
+    ns = [r["namespace"] for r in reqs]
+    assert ns.count("ns-aff-hot") == 90
+    assert ns.count("ns-aff-cold") == 10
+    assert all(
+        r["object"]["metadata"]["namespace"] == r["namespace"]
+        for r in reqs
+    )
+
+
 # -- open loop ---------------------------------------------------------------
 
 

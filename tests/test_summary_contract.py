@@ -232,6 +232,8 @@ def test_bench_compare_loads_artifacts_and_summary_logs(tmp_path):
         + format_summary("attribution", {
             "rungs": 3, "sums_ok": True, "attribution_ratio": 1.0,
             "dispatch_efficiency": {"200": 0.9},
+            "partitions_touched_p50": {"200": 2},
+            "partitions_touched_max": {"200": 4},
         })
         + "\n"
     )
@@ -247,3 +249,85 @@ def test_bench_compare_loads_artifacts_and_summary_logs(tmp_path):
     assert bench_compare.main([str(art), str(cand2)]) == 1
     same = bench_compare.main([str(art), str(art)])
     assert same == 0
+
+
+# -- bench_compare: the pruned-dispatch trajectory gate ----------------------
+#
+# BENCH_r04.json is an rc=1 crash artifact (TPU backend unavailable:
+# no rungs, `parsed: null`) — it cannot anchor a comparison, so these
+# tests pin the gate on synthetic docs derived from BENCH_r05's real
+# ladder numbers (fused p50 408/426/981 ms at c=50/100/200).
+
+R05_FUSED_P50 = {50: 408.2, 100: 425.81, 200: 981.46}
+
+
+def _pruned_doc(eff_by_rung, touched_by_rung, p50_scale=1.0):
+    """An attribution-shaped doc with BENCH_r05-derived latencies plus
+    the pruning headline metrics this PR adds."""
+    return {"rungs": [
+        {
+            "constraints": n,
+            "replay": {"p50_ms": round(R05_FUSED_P50[n] * p50_scale, 2)},
+            "dispatch_efficiency": eff_by_rung[n],
+            "partitions_touched_p50": touched_by_rung[n],
+            "partitions_touched_max": touched_by_rung[n] + 1,
+        }
+        for n in sorted(R05_FUSED_P50)
+    ]}
+
+
+def test_bench_compare_crash_artifact_compares_nothing():
+    """The BENCH_r04 shape (rc=1, parsed: null, no rungs) flattens to
+    zero watched metrics — a crash artifact can never green-light OR
+    red-light a candidate, which is why the pruning gate anchors on
+    synthetic r05-derived docs instead."""
+    crash = {"n": 4, "cmd": "bench_webhook.py --ladder", "rc": 1,
+             "tail": "RuntimeError: Unable to initialize backend",
+             "parsed": None}
+    good = _pruned_doc({50: 0.4, 100: 0.3, 200: 0.2},
+                       {50: 2, 100: 2, 200: 1})
+    rep = bench_compare.compare_runs(crash, good)
+    assert rep["compared"] == 0 and rep["ok"]
+
+
+def test_bench_compare_exits_1_on_dispatch_efficiency_regression(
+    tmp_path,
+):
+    """The acceptance wiring: pruning that got worse (more of the
+    corpus dispatched per request) fails the gate with exit code 1,
+    even when latency held."""
+    base = _pruned_doc({50: 0.40, 100: 0.30, 200: 0.15},
+                       {50: 2, 100: 2, 200: 1})
+    # same latency, but efficiency collapses toward the monolith
+    cand = _pruned_doc({50: 0.90, 100: 0.85, 200: 0.80},
+                       {50: 2, 100: 2, 200: 1})
+    b, c = tmp_path / "base.json", tmp_path / "cand.json"
+    b.write_text(json.dumps(base))
+    c.write_text(json.dumps(cand))
+    assert bench_compare.main([str(b), str(c)]) == 1
+    rep = bench_compare.compare_runs(base, cand)
+    flagged = {r["metric"].rsplit(".", 1)[-1] for r in rep["regressions"]}
+    assert flagged == {"dispatch_efficiency"}
+    assert len(rep["regressions"]) == 3  # one per rung, ctx-aligned
+    assert bench_compare.main([str(b), str(b)]) == 0
+
+
+def test_bench_compare_flags_partitions_touched_widening():
+    """More partitions touched per batch = less pruning: a rise past
+    the threshold regresses; a narrowing is an improvement; latency
+    moving WITH the widening is reported alongside."""
+    base = _pruned_doc({50: 0.4, 100: 0.3, 200: 0.2},
+                       {50: 2, 100: 2, 200: 1})
+    wide = _pruned_doc({50: 0.4, 100: 0.3, 200: 0.2},
+                       {50: 6, 100: 7, 200: 8}, p50_scale=1.6)
+    rep = bench_compare.compare_runs(base, wide, threshold=0.20)
+    assert not rep["ok"]
+    flagged = {r["metric"].rsplit(".", 1)[-1] for r in rep["regressions"]}
+    assert flagged == {
+        "partitions_touched_p50", "partitions_touched_max", "p50_ms",
+    }
+    # narrowing back is an improvement, not a regression
+    rep2 = bench_compare.compare_runs(wide, base, threshold=0.20)
+    assert rep2["ok"]
+    leafs = {r["metric"].rsplit(".", 1)[-1] for r in rep2["improvements"]}
+    assert "partitions_touched_p50" in leafs
