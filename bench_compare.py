@@ -46,6 +46,11 @@ WATCHED: Dict[str, int] = {
     "partitions_touched_max": +1,
     "shed_rate": +1,
     "cold_fetch_amplification": +1,
+    # incremental compile plane: slower ingest-to-serve or ANY
+    # degraded/5xx during an ingest wave is a regression
+    "ingest_to_serve_ms": +1,
+    "degraded_dispatches": +1,
+    "http_5xx": +1,
     "throughput_rps": -1,
     "slo_attainment": -1,
     "cache_hit_rate": -1,
@@ -55,7 +60,7 @@ WATCHED: Dict[str, int] = {
 # phases are lists — a bare index would misalign when a rung is
 # skipped by a time budget)
 _KEY_FIELDS = ("constraints", "phase", "concurrency", "violating",
-               "partition", "mode", "replicas")
+               "partition", "mode", "replicas", "wave")
 
 
 def _flatten(node: Any, path: str, out: Dict[str, float]) -> None:

@@ -777,6 +777,176 @@ def run_partitions_bench(n_requests=2000, n_constraints=40, k=4,
     }
 
 
+_CHURN_BENCH_REGO = """package churnbench{n}
+
+violation[{{"msg": msg}}] {{
+    input.review.object.spec.containers[_].securityContext.privileged
+    msg := "churn{n}: privileged container"
+}}
+"""
+
+
+def run_churn_bench(n_requests=600, wave_sizes=(10, 50, 500), k=4,
+                    err=sys.stderr):
+    """The `--churn` lane (docs/compile.md §Bench): template ingest
+    waves against a partitioned plan under live admission load. Per
+    wave size it reports ingest-to-serve latency (first template add ->
+    every partition's swapped program serving fused again) plus the
+    zero-downtime counters: degraded dispatches and in-process 5xx
+    (handler exceptions) during the wave must both be zero — in-flight
+    batches ride the old programs or the host rung while the shadow
+    slot compiles."""
+    from gatekeeper_tpu.constraint import TpuDriver
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.parallel.partition import PartitionDispatcher
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    metrics = MetricsRegistry()
+    client = build_partition_client(TpuDriver(), 16)
+    driver = client._driver
+    disp = PartitionDispatcher(
+        client, TARGET, k=k, metrics=metrics,
+        failure_threshold=3, recovery_seconds=1.0,
+    )
+    batcher = MicroBatcher(
+        client, TARGET, window_ms=2.0, metrics=metrics,
+        max_queue=512, partitioner=disp,
+    )
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=10, metrics=metrics, fail_policy="open"
+    )
+    deg_key = 'webhook_degraded_dispatch_total{plane="validation"}'
+
+    def replay_counting(requests, concurrency=64):
+        """replay() that counts handler exceptions — what the HTTP
+        plane would surface as 5xx — instead of propagating them."""
+        lat = np.zeros(len(requests))
+        errs = np.zeros(len(requests), bool)
+
+        def one(i):
+            t0 = time.perf_counter()
+            try:
+                handler.handle(requests[i])
+            except Exception:
+                errs[i] = True
+            lat[i] = time.perf_counter() - t0
+
+        with ThreadPoolExecutor(max_workers=concurrency) as ex:
+            list(ex.map(one, range(len(requests))))
+        return {
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+            "http_5xx": int(errs.sum()),
+        }
+
+    def all_ready():
+        plan = disp.plan()
+        ready = getattr(driver, "subset_ready", None)
+        if ready is None:
+            return True
+        return all(ready(TARGET, p.subset) for p in plan.partitions)
+
+    def mixed(n, start=0):
+        return [part_request(start + i, i % 4) for i in range(n)]
+
+    churn_n = 0
+    waves = []
+    batcher.start()
+    try:
+        _warm_route(client)
+        for p in disp.plan().partitions:
+            disp.ensure_staged(p)
+        replay_counting(mixed(max(128, n_requests // 4)))
+        for wave in wave_sizes:
+            deg0 = metrics.snapshot()["counters"].get(deg_key, 0)
+            c0 = getattr(driver, "program_compiles", 0)
+            s0 = getattr(driver, "subset_swaps", 0)
+            cf0 = getattr(driver, "subset_carryforwards", 0)
+            http_5xx = 0
+            t0 = time.perf_counter()
+            for _ in range(wave):
+                churn_n += 1
+                kind = f"ChurnBench{churn_n}"
+                client.add_template({
+                    "apiVersion": "templates.gatekeeper.sh/v1beta1",
+                    "kind": "ConstraintTemplate",
+                    "metadata": {"name": kind.lower()},
+                    "spec": {
+                        "crd": {"spec": {"names": {"kind": kind}}},
+                        "targets": [{
+                            "target": TARGET,
+                            "rego": _CHURN_BENCH_REGO.format(n=churn_n),
+                        }],
+                    },
+                })
+                client.add_constraint({
+                    "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                    "kind": kind,
+                    "metadata": {"name": f"wave-{churn_n}"},
+                    "spec": {"match": {
+                        "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+                        "namespaces": [f"part-ns-{churn_n % 4}"],
+                    }},
+                })
+            # serve through the churn: traffic keeps flowing while the
+            # changed partitions shadow-compile and swap; readiness is
+            # every partition of the NEW plan serving its new program
+            ingest_to_serve_ms = None
+            rounds = 0
+            while rounds < 120:
+                r = replay_counting(mixed(128, start=rounds * 128))
+                http_5xx += r["http_5xx"]
+                rounds += 1
+                if all_ready():
+                    ingest_to_serve_ms = round(
+                        (time.perf_counter() - t0) * 1e3, 1
+                    )
+                    break
+            steady = replay_counting(mixed(max(128, n_requests // 4)))
+            http_5xx += steady["http_5xx"]
+            row = {
+                "wave": wave,
+                "ingest_to_serve_ms": ingest_to_serve_ms,
+                "degraded_dispatches": (
+                    metrics.snapshot()["counters"].get(deg_key, 0) - deg0
+                ),
+                "http_5xx": http_5xx,
+                "compiles": getattr(driver, "program_compiles", 0) - c0,
+                "swaps": getattr(driver, "subset_swaps", 0) - s0,
+                "carryforwards": (
+                    getattr(driver, "subset_carryforwards", 0) - cf0
+                ),
+                "serve_rounds": rounds,
+                "steady_p50_ms": steady["p50_ms"],
+                "steady_p99_ms": steady["p99_ms"],
+            }
+            waves.append(row)
+            print(f"churn wave: {row}", file=err)
+    finally:
+        batcher.stop()
+        disp.close()
+    return {
+        "partitions": k,
+        "waves": waves,
+        "ingest_to_serve_ms": (
+            waves[-1]["ingest_to_serve_ms"] if waves else None
+        ),
+        "degraded_dispatches": sum(
+            w["degraded_dispatches"] for w in waves
+        ),
+        "http_5xx": sum(w["http_5xx"] for w in waves),
+        "compiles": sum(w["compiles"] for w in waves),
+        "swaps": sum(w["swaps"] for w in waves),
+        "compile_plane": (
+            driver.compile_plane_stats()
+            if hasattr(driver, "compile_plane_stats") else None
+        ),
+    }
+
+
 _EXTERNAL_REGO = """package externalbench
 
 violation[{"msg": msg}] {
@@ -1787,6 +1957,16 @@ def _summarize(mode, res):
             prof = res.get("profile")
             if prof:
                 head["profile_trace_dir"] = prof.get("trace_dir")
+        elif mode == "churn":
+            waves = res.get("waves") or []
+            head["waves"] = len(waves)
+            if waves:
+                head["wave"] = waves[-1].get("wave")
+            head["ingest_to_serve_ms"] = res.get("ingest_to_serve_ms")
+            head["degraded_dispatches"] = res.get("degraded_dispatches")
+            head["http_5xx"] = res.get("http_5xx")
+            head["compiles"] = res.get("compiles")
+            head["swaps"] = res.get("swaps")
         elif mode == "mutate":
             replays = res.get("replays") or []
             if replays:
@@ -1907,6 +2087,18 @@ if __name__ == "__main__":
         res = run_partitions_bench(n_req, n_con, n_parts)
         print(json.dumps(res))
         print(_summarize("partitions", res))
+    elif "--churn" in sys.argv:
+        pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n_req = int(pos[0]) if pos else 600
+        sizes = (
+            tuple(int(x) for x in pos[1].split(","))
+            if len(pos) > 1
+            else (10, 50, 500)
+        )
+        n_parts = int(pos[2]) if len(pos) > 2 else 4
+        res = run_churn_bench(n_req, sizes, n_parts)
+        print(json.dumps(res))
+        print(_summarize("churn", res))
     elif "--external" in sys.argv:
         pos = [a for a in sys.argv[1:] if not a.startswith("--")]
         n_req = int(pos[0]) if pos else 3_000

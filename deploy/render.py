@@ -21,7 +21,10 @@ Design notes carried over from the hand-written manifest:
     behaviorally), the cache/breaker state plane gossips through
     FleetState CRs, and a PodDisruptionBudget keeps at least one
     replica through voluntary disruption;
-  * the compile-cache volume turns pod restarts into warm boots; Ready
+  * the compile-cache volume turns pod restarts into warm boots; the
+    program store under it is content-addressed and fingerprint-gated
+    (docs/compile.md), so a PVC shared across a MIXED node pool is
+    safe — foreign-machine artifacts are rejected, never loaded; Ready
     gates on state replay only (serve-while-compiling), so a cold
     cache degrades latency briefly, never availability;
   * RBAC is a scoped ClusterRole (read-everything + CRUD on CRDs,
@@ -92,7 +95,9 @@ DEFAULT_VALUES: Dict[str, Any] = {
         "resources": {"limits": {"google.com/tpu": "1"}},
     },
     # emptyDir by default; set to a PVC claim name for persistent warm
-    # XLA compile caches across pod restarts
+    # XLA compile caches across pod restarts. The store adopts entries
+    # per machine fingerprint (platform/device/CPU-flags/jaxlib), so one
+    # claim can back heterogeneous node pools (docs/compile.md).
     "compileCachePVC": None,
 }
 

@@ -1,0 +1,19 @@
+"""Incremental compile plane: content-addressed program store with
+fingerprint-gated load (docs/compile.md).
+
+The store wraps the persistent XLA compile cache with provenance: every
+artifact is content-addressed (sha256) and attested with the machine
+fingerprint that produced it (platform + CPU feature set + jaxlib
+version + device kind). A foreign or corrupt artifact is skipped and
+counted (`program_store_rejected_total{reason}`), never handed to XLA —
+the "could lead to execution errors such as SIGILL" class from sharing
+one flat cache dir across heterogeneous node pools dies here, failing
+closed to a recompile instead of a crash loop.
+"""
+
+from .store import (  # noqa: F401
+    SCHEMA_VERSION,
+    ProgramStore,
+    machine_fingerprint,
+    store_from_env,
+)

@@ -463,9 +463,13 @@ class Client:
         prep = getattr(self._driver, "prepare_subset", None)
         if prep is None:
             return True
+        ok = True
         for name in self.targets:
-            prep(f'hooks["{name}"].violation', subset, device=device)
-        return True
+            # False = lost a race with newer churn (not a failure); the
+            # dispatcher leaves the token unstaged and retries
+            if prep(f'hooks["{name}"].violation', subset, device=device) is False:
+                ok = False
+        return ok
 
     def prefetch_external(self, objs: Sequence[Any]) -> None:
         """Batch-plane external-data prefetch for a review batch that

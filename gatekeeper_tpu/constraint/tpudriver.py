@@ -37,6 +37,7 @@ battery is enforced by tests/test_tpu_driver.py.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import re
 from dataclasses import dataclass
@@ -119,35 +120,45 @@ def _params_key(params: Any) -> str:
 
 
 _CACHE_ENABLED = False
+# process-wide ProgramStore (gatekeeper_tpu/compile): the persistent
+# XLA cache now lives behind the fingerprint gate — XLA only ever reads
+# this machine's private per-fingerprint subdir, never a foreign blob
+_STORE = None
 
 
-def _enable_compile_cache() -> None:
+def _enable_compile_cache():
     """Persistent XLA compilation cache: template ingest re-pays minutes
     of XLA compile per fresh process otherwise (the reference's
     interpreter has no compile step to amortize; this engine does).
     Opt out with GATEKEEPER_TPU_NO_COMPILE_CACHE=1; relocate with
-    GATEKEEPER_TPU_COMPILE_CACHE_DIR."""
-    global _CACHE_ENABLED
-    if _CACHE_ENABLED:
-        return
-    _CACHE_ENABLED = True
-    import os
+    GATEKEEPER_TPU_COMPILE_CACHE_DIR.
 
-    if os.environ.get("GATEKEEPER_TPU_NO_COMPILE_CACHE") == "1":
-        return
-    cache_dir = os.environ.get(
-        "GATEKEEPER_TPU_COMPILE_CACHE_DIR",
-        os.path.expanduser("~/.cache/gatekeeper_tpu/xla"),
-    )
+    Routed through the content-addressed program store (docs/compile.md):
+    the store root holds attested artifacts; XLA's cache dir is the
+    store's by-fingerprint subdir, populated only with artifacts whose
+    attested machine fingerprint matches this process — a cache volume
+    shared across heterogeneous node pools can no longer feed XLA an
+    AOT artifact compiled for a different ISA (the MULTICHIP_r05 SIGILL
+    warning class). Returns the store (None = caching disabled)."""
+    global _CACHE_ENABLED, _STORE
+    if _CACHE_ENABLED:
+        return _STORE
+    _CACHE_ENABLED = True
     try:
+        from ..compile import store_from_env
+
+        store = store_from_env()
+        if store is None:
+            return None
         import jax
 
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_compilation_cache_dir", store.xla_cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _STORE = store
     except Exception:
-        pass  # cache is an optimization; never fail driver construction
+        _STORE = None  # cache is an optimization; never fail construction
+    return _STORE
 
 
 @dataclass
@@ -199,6 +210,10 @@ class _ConstraintSet:
     # (CODE_MISMATCH when the analyzer predicted compilable but the
     # compiler disagreed)
     fallback_codes: Dict[str, str] = None  # type: ignore[assignment]
+    # content signature of this (sub)set's constraints + template IR
+    # (docs/compile.md): a constraint-generation bump whose signature is
+    # unchanged carries the staged policy forward instead of restaging
+    signature: Optional[str] = None
 
 
 class TpuDriver(RegoDriver):
@@ -207,8 +222,9 @@ class TpuDriver(RegoDriver):
 
     def __init__(self, use_jax: bool = True, mesh=None, metrics=None):
         super().__init__()
-        if use_jax:
-            _enable_compile_cache()
+        # fingerprint-gated program store (docs/compile.md); None when
+        # caching is disabled (tests) or the store root is unwritable
+        self.program_store = _enable_compile_cache() if use_jax else None
         # optional MetricsRegistry: per-template verdict gauges +
         # fallback-reason counters land here when wired (Runner calls
         # set_metrics; tests construct with metrics=)
@@ -302,6 +318,16 @@ class TpuDriver(RegoDriver):
         # with the partition that paid it (docs/observability.md
         # §Cost attribution)
         self.attributor = None
+        # incremental compile plane (docs/compile.md): template IR
+        # hashes + per-subset content signatures drive minimal
+        # recompiles — churn restages only partitions whose signature
+        # changed, and staged sub-programs swap atomically
+        self._ir_hashes: Dict[Tuple[str, str], str] = {}
+        self._sig_cache: Dict[Tuple[str, frozenset], Tuple[int, str]] = {}
+        self._swap_gen = 0
+        self.program_compiles = 0  # compile_program invocations
+        self.subset_swaps = 0  # shadow sets atomically swapped live
+        self.subset_carryforwards = 0  # gen bumps served by signature
 
     # -- module/data bookkeeping (cache invalidation) ------------------------
 
@@ -329,6 +355,7 @@ class TpuDriver(RegoDriver):
             del self._programs[key]
         self._analysis.pop((target, kind), None)
         self._fallback_codes.pop((target, kind), None)
+        self._ir_hashes.pop((target, kind), None)
         for cache in (self._prune_oracles, self._prune_indexes):
             for key in [
                 k for k in cache if k[0] == target and k[1] == kind
@@ -407,6 +434,11 @@ class TpuDriver(RegoDriver):
         self.metrics = metrics
         if self.kernel is not None:
             self.kernel.metrics = metrics
+        if (
+            self.program_store is not None
+            and self.program_store.metrics is None
+        ):
+            self.program_store.metrics = metrics
         for (_t, kind), rep in self._analysis.items():
             self._export_verdict(kind, rep)
 
@@ -564,6 +596,13 @@ class TpuDriver(RegoDriver):
             template_kind=kind,
             extdata_feature=extdata_feature,
         )
+        # an actual compile is happening: nothing in memory covered this
+        # (target, kind, params) — the plan-diff battery asserts churn
+        # of N kinds pays exactly N of these
+        self.program_compiles += 1
+        self._count("program_store_compiles_total", kind=kind)
+        if self.program_store is not None:
+            self.program_store.note_miss()
         try:
             prog = compile_program(env, mods, params)
         except CompileUnsupported as e:
@@ -656,6 +695,110 @@ class TpuDriver(RegoDriver):
                 for c in self._constraints(target)
             }
 
+    # -- incremental compile plane (docs/compile.md) -------------------------
+
+    def _ir_hash(self, target: str, kind: str) -> str:
+        """Content hash of a template's rewritten IR modules. AST nodes
+        are plain dataclasses, so repr() is a stable structural
+        rendering; memoized until put/delete_modules drops the kind."""
+        key = (target, kind)
+        h = self._ir_hashes.get(key)
+        if h is None:
+            mods = self._kind_modules.get(key)
+            h = (
+                hashlib.sha256(repr(mods).encode()).hexdigest()[:16]
+                if mods is not None
+                else ""
+            )
+            self._ir_hashes[key] = h
+        return h
+
+    def _subset_signature(self, target: str, subset: frozenset) -> str:
+        """Content signature of one partition's sub-program: per member
+        constraint, (key, template IR hash, constraint payload), plus
+        the store's machine fingerprint. Two constraint generations
+        with equal signatures stage byte-identical sub-programs, which
+        is what licenses the carry-forward (no restage, no recompile).
+        Memoized per constraint generation (caller holds the mutex)."""
+        key = (target, subset)
+        hit = self._sig_cache.get(key)
+        if hit is not None and hit[0] == self._constraint_gen:
+            return hit[1]
+        parts = []
+        for c in self._constraints(target):
+            ck = constraint_key(c)
+            if ck not in subset:
+                continue
+            kind = c.get("kind")
+            parts.append((
+                ck,
+                self._ir_hash(
+                    target, kind if isinstance(kind, str) else ""
+                ),
+                json.dumps(c, sort_keys=True, default=str),
+            ))
+        parts.sort()
+        fp = (
+            self.program_store.fp_digest
+            if self.program_store is not None
+            else ""
+        )
+        sig = hashlib.sha256(
+            json.dumps([fp, parts]).encode()
+        ).hexdigest()[:16]
+        if len(self._sig_cache) >= 4 * self._cset_sub_max:
+            self._sig_cache.pop(next(iter(self._sig_cache)), None)
+        self._sig_cache[key] = (self._constraint_gen, sig)
+        return sig
+
+    def subset_signature(self, target: str, subset) -> str:
+        """Public (dispatcher-facing) form of `_subset_signature`."""
+        with self._mutex:
+            return self._subset_signature(target, frozenset(subset))
+
+    def subset_ready(self, target: str, subset) -> bool:
+        """True when `subset`'s sub-program can serve a fused dispatch
+        RIGHT NOW without compiling or staging: its constraint set is
+        cached with a staged policy and its content signature matches
+        the current constraint corpus. Drivers without a device kernel
+        have nothing to stage and are always ready. The dispatcher uses
+        this to decide sync vs background restage (docs/compile.md)."""
+        if not self.use_jax or self.kernel is None:
+            return True
+        with self._mutex:
+            fs = frozenset(subset)
+            cs = self._cset_sub.get((target, fs))
+            if cs is None or cs.policy is None:
+                return False
+            if cs.constraint_gen == self._constraint_gen:
+                return True
+            return (
+                cs.signature is not None
+                and cs.signature == self._subset_signature(target, fs)
+            )
+
+    def swap_generation(self) -> int:
+        """Monotonic count of atomic sub-program swaps (prepare_subset
+        landing a shadow set live) — /debug/programs surfaces it."""
+        return self._swap_gen
+
+    def compile_plane_stats(self) -> Dict[str, Any]:
+        """Compile-plane counters + program-store view, the driver side
+        of /debug/programs and the compile_storm flight record."""
+        with self._mutex:
+            out: Dict[str, Any] = {
+                "constraint_generation": self._constraint_gen,
+                "swap_generation": self._swap_gen,
+                "program_compiles": self.program_compiles,
+                "subset_swaps": self.subset_swaps,
+                "subset_carryforwards": self.subset_carryforwards,
+                "analyzer_mismatches": self.analyzer_mismatches,
+            }
+        store = self.program_store
+        if store is not None:
+            out["store"] = store.stats()
+        return out
+
     def _subset_cset(
         self, target: str, subset: frozenset
     ) -> Optional[_ConstraintSet]:
@@ -665,17 +808,43 @@ class TpuDriver(RegoDriver):
         fault domain. Programs come from the shared `_programs` cache
         (a subset never re-compiles what the monolith compiled), and —
         unlike `_constraint_set` — no program eviction runs here: the
-        subset view must never evict programs the full set still uses."""
+        subset view must never evict programs the full set still uses.
+
+        Generation bumps whose content signature is unchanged carry the
+        cached set (and its staged policy) forward instead of
+        rebuilding: churn elsewhere in the corpus costs THIS partition
+        nothing (docs/compile.md)."""
         key = (target, subset)
         cs = self._cset_sub.get(key)
         if cs is not None and cs.constraint_gen == self._constraint_gen:
             return cs
+        sig = self._subset_signature(target, subset)
+        if cs is not None and cs.signature is not None and cs.signature == sig:
+            cs.constraint_gen = self._constraint_gen
+            self.subset_carryforwards += 1
+            self._count("program_carryforward_total", target=target)
+            return cs
+        cs = self._build_subset_cset(target, subset, sig)
+        if cs is None:
+            self._cset_sub.pop(key, None)
+            return None
+        while len(self._cset_sub) >= self._cset_sub_max:
+            self._cset_sub.pop(next(iter(self._cset_sub)), None)
+        self._cset_sub[key] = cs
+        return cs
+
+    def _build_subset_cset(
+        self, target: str, subset: frozenset, sig: Optional[str] = None
+    ) -> Optional[_ConstraintSet]:
+        """Construct (but do NOT cache) a subset constraint set — the
+        shared builder behind `_subset_cset` and `prepare_subset`'s
+        shadow slot, which must never replace the live entry before its
+        policy is staged."""
         constraints = [
             c for c in self._constraints(target)
             if constraint_key(c) in subset
         ]
         if not constraints:
-            self._cset_sub.pop(key, None)
             return None
         ms = self._handler(target).compile_match_specs(
             constraints, self.vocab
@@ -694,7 +863,7 @@ class TpuDriver(RegoDriver):
             for c, p in zip(constraints, programs)
             if p is None and isinstance(c.get("kind"), str)
         }
-        cs = _ConstraintSet(
+        return _ConstraintSet(
             constraint_gen=self._constraint_gen,
             constraints=constraints,
             ms=matchspec_to_np(ms),
@@ -703,11 +872,12 @@ class TpuDriver(RegoDriver):
             fallback_codes={
                 k: v or "GK-V007" for k, v in fallback_codes.items()
             },
+            signature=(
+                sig
+                if sig is not None
+                else self._subset_signature(target, subset)
+            ),
         )
-        while len(self._cset_sub) >= self._cset_sub_max:
-            self._cset_sub.pop(next(iter(self._cset_sub)), None)
-        self._cset_sub[key] = cs
-        return cs
 
     # -- corpus encoding -----------------------------------------------------
 
@@ -1482,18 +1652,70 @@ class TpuDriver(RegoDriver):
         the restage step of quarantine re-homing — the device-labeled
         fault point (`driver.restage[device=N]`) makes restage failure
         injectable, and the quarantine manager retries with backoff
-        while the subset serves from the host rung."""
+        while the subset serves from the host rung.
+
+        Incremental compile plane (docs/compile.md): a signature-
+        unchanged subset carries its staged policy across the
+        generation bump (no restage). A changed subset builds a SHADOW
+        constraint set, stages its policy OFF the serving mutex — in-
+        flight batches keep dispatching the old sub-program meanwhile —
+        then atomically swaps it live. The `compile.swap` fault point
+        sits between stage and swap: an injected mid-swap failure
+        leaves the old sub-program serving. Returns False (not an
+        error) when the corpus churned again mid-stage — the caller's
+        next restage pass picks up the newer generation."""
         m = _HOOK_RE.match(path)
         if m is None or m.group(2) != "violation":
             raise ValueError(f"unsupported partition query path: {path!r}")
         target = m.group(1)
+        fs = frozenset(subset)
         fire(device_point("driver.restage", device))
         with self._mutex:
-            cs = self._subset_cset(target, frozenset(subset))
-            if cs is None:
+            gen = self._constraint_gen
+            cs = self._cset_sub.get((target, fs))
+            if cs is not None and cs.constraint_gen != gen:
+                sig = self._subset_signature(target, fs)
+                if cs.signature is not None and cs.signature == sig:
+                    cs.constraint_gen = gen
+                    self.subset_carryforwards += 1
+                    self._count("program_carryforward_total", target=target)
+                else:
+                    cs = None
+            if cs is not None:
+                if (
+                    self.use_jax
+                    and self.kernel is not None
+                    and cs.policy is None
+                ):
+                    cs.policy = self.kernel.stage_policy(cs.programs, cs.ms)
                 return True
-            if self.use_jax and self.kernel is not None and cs.policy is None:
-                cs.policy = self.kernel.stage_policy(cs.programs, cs.ms)
+            shadow = self._build_subset_cset(target, fs)
+            if shadow is None:
+                self._cset_sub.pop((target, fs), None)
+                return True
+        # OFF the mutex: the policy upload / XLA compile — the live
+        # entry (if any) keeps serving fused dispatches throughout
+        if self.use_jax and self.kernel is not None:
+            shadow.policy = self.kernel.stage_policy(
+                shadow.programs, shadow.ms
+            )
+        # mid-swap fault point: failure here must leave the old
+        # sub-program live (tests/test_compile_plane.py)
+        fire("compile.swap")
+        with self._mutex:
+            if self._constraint_gen != gen:
+                return False
+            while len(self._cset_sub) >= self._cset_sub_max:
+                self._cset_sub.pop(next(iter(self._cset_sub)), None)
+            self._cset_sub[(target, fs)] = shadow
+            self._swap_gen += 1
+            self.subset_swaps += 1
+            self._count("program_swap_total", target=target)
+        if self.program_store is not None:
+            try:
+                self.program_store.attest()
+            except Exception:
+                pass
         return True
 
     # -- serve-while-compiling (cold-start) ----------------------------------
@@ -1623,6 +1845,13 @@ class TpuDriver(RegoDriver):
                         target, cs, reviews[:1], self._ns_cache(target)
                     )
                     self._row_feature_bits(target, real, precompute)
+            except Exception:
+                pass
+        # content-address + attest whatever the compile just landed in
+        # the XLA cache dir, so identical machines can adopt it
+        if warmed and self.program_store is not None:
+            try:
+                self.program_store.attest()
             except Exception:
                 pass
         return warmed

@@ -522,6 +522,11 @@ class Runner:
                 self.recorder.add_source(
                     "partitions", wh.partitioner.postmortem
                 )
+                # compile-plane state: a compile_storm record embeds the
+                # program-store table + per-partition signatures
+                self.recorder.add_source(
+                    "programs", wh.partitioner.programs_table
+                )
             if self.fleet is not None:
                 self.recorder.add_source("fleet", self.fleet.snapshot)
             self.webhook.start()
@@ -965,6 +970,24 @@ class Runner:
                     if part is not None:
                         payload = json.dumps(
                             part.plan_table()
+                        ).encode()
+                        self.send_response(200)
+                    else:
+                        payload = (
+                            b'{"error": "partitions disabled"}'
+                        )
+                        self.send_response(404)
+                elif self.path == "/debug/programs":
+                    # compile plane: per-partition sub-program
+                    # signature/staging state + program-store
+                    # hit/miss/rejected and swap generation
+                    # (docs/compile.md)
+                    part = getattr(
+                        runner.webhook, "partitioner", None
+                    )
+                    if part is not None:
+                        payload = json.dumps(
+                            part.programs_table()
                         ).encode()
                         self.send_response(200)
                     else:

@@ -420,7 +420,9 @@ def serve_metrics(
     /debug/costs (the top-K cost table), a flight recorder adds
     /debug/flightrecords, a decision log adds /debug/decisions, and a
     partition dispatcher adds /debug/partitions (the live cost/locality
-    plan composition) — the same debug surface the health plane
+    plan composition) and /debug/programs (the compile plane: per-
+    partition sub-program signatures + program-store stats,
+    docs/compile.md) — the same debug surface the health plane
     serves."""
 
     class _Handler(BaseHTTPRequestHandler):
@@ -449,6 +451,11 @@ def serve_metrics(
                 )
             elif partitions is not None and route == "/debug/partitions":
                 payload = json.dumps(partitions.plan_table()).encode()
+                ctype = "application/json"
+            elif partitions is not None and route == "/debug/programs":
+                payload = json.dumps(
+                    partitions.programs_table()
+                ).encode()
                 ctype = "application/json"
             else:
                 payload = b'{"error": "not found"}'

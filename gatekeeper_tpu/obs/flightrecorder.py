@@ -81,6 +81,11 @@ class FlightRecorder:
         # window trips one "shed_burst" record
         shed_burst_threshold: int = 50,
         shed_burst_window_s: float = 5.0,
+        # compile-storm detection (note_restage_failure): this many
+        # restage failures inside the window — or a recompile backlog
+        # at least this deep — trips one "compile_storm" record
+        compile_storm_threshold: int = 8,
+        compile_storm_window_s: float = 10.0,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.tracer = tracer
@@ -98,6 +103,8 @@ class FlightRecorder:
         self.top_k_costs = top_k_costs
         self.shed_burst_threshold = max(1, int(shed_burst_threshold))
         self.shed_burst_window_s = shed_burst_window_s
+        self.compile_storm_threshold = max(1, int(compile_storm_threshold))
+        self.compile_storm_window_s = compile_storm_window_s
         self._clock = clock
         self._sources: Dict[str, Callable[[], Any]] = {}
         self._lock = threading.Lock()
@@ -110,6 +117,7 @@ class FlightRecorder:
         self._last_capture: Optional[float] = None
         self._sheds: deque = deque()  # monotonic stamps per plane-shed
         self._shed_lock = threading.Lock()
+        self._restage_fails: deque = deque()  # stamps per restage fail
         self.captured = 0
         self.suppressed = 0
 
@@ -165,6 +173,32 @@ class FlightRecorder:
                 "shed_burst", plane=plane,
                 threshold=self.shed_burst_threshold,
                 window_s=self.shed_burst_window_s,
+            )
+
+    def note_restage_failure(
+        self, plane: str = "validation", backlog: int = 0
+    ) -> None:
+        """Compile-storm detector (docs/compile.md §Failure modes): a
+        burst of restage failures inside the rolling window — or a
+        recompile backlog already at the threshold — trips ONE
+        `compile_storm` capture; the `programs` source then embeds the
+        program-store state table in the record. Debounce + rate limit
+        are the shared trigger machinery."""
+        now = self._clock()
+        fire = int(backlog) >= self.compile_storm_threshold
+        with self._shed_lock:
+            self._restage_fails.append(now)
+            horizon = now - self.compile_storm_window_s
+            while self._restage_fails and self._restage_fails[0] < horizon:
+                self._restage_fails.popleft()
+            if len(self._restage_fails) >= self.compile_storm_threshold:
+                self._restage_fails.clear()
+                fire = True
+        if fire:
+            self.trigger(
+                "compile_storm", plane=plane, backlog=int(backlog),
+                threshold=self.compile_storm_threshold,
+                window_s=self.compile_storm_window_s,
             )
 
     # -- the worker -----------------------------------------------------------

@@ -341,7 +341,13 @@ class PartitionDispatcher:
         self._plan: Optional[PartitionPlan] = None
         self._plan_key: Any = None
         self._plan_gen = 0
-        self._staged: set = set()  # (plan_gen, partition idx, device)
+        # staged tokens: (subset, device, signature) when the driver
+        # exposes content signatures (docs/compile.md — churn that
+        # changes a partition's signature invalidates exactly that
+        # token), else the legacy (plan_gen, partition idx, device)
+        self._staged: set = set()
+        self._staged_parts: set = set()  # partition indexes ever staged
+        self._staging: set = set()  # (subset, device) restages in flight
         self._retry_at: Dict[int, float] = {}  # device -> next restage
         self._backoff: Dict[int, float] = {}
         self._executor: Optional[ThreadPoolExecutor] = None
@@ -498,6 +504,34 @@ class PartitionDispatcher:
                             plane=self.plane,
                         )
             self._plan, self._plan_key = plan, key
+            # prune staged tokens the new plan obsoletes: signature
+            # tokens survive re-planning while their (subset, device)
+            # placement persists; legacy tokens die with their plan gen
+            live = {(p.subset, p.device) for p in plan.partitions}
+            self._staged = {
+                t for t in self._staged
+                if (
+                    (isinstance(t[0], frozenset) and (t[0], t[1]) in live)
+                    or (
+                        not isinstance(t[0], frozenset)
+                        and t[0] == self._plan_gen
+                    )
+                )
+            }
+            # churn replay: partitions that HAVE served fused and whose
+            # sub-program content changed restage proactively in the
+            # background, so the swap usually lands before the next
+            # batch even asks (never-staged partitions stay lazy — the
+            # first dispatch stages them synchronously, preserving the
+            # cold-start contract)
+            prestage = (
+                [p for p in plan.partitions if p.index in self._staged_parts]
+                if prev is not None
+                else []
+            )
+        for p in prestage:
+            if not self._subset_ready(p):
+                self._spawn_restage(p)
         if self.metrics is not None:
             self.metrics.gauge(
                 "device_partition_count", len(plan.partitions),
@@ -542,44 +576,133 @@ class PartitionDispatcher:
 
     # -- restage (quarantine re-home) ------------------------------------------
 
-    def ensure_staged(self, part: Partition) -> bool:
-        """Stage `part`'s sub-program on its current device before the
-        first fused dispatch of a plan generation. A restage failure
-        (the `driver.restage[device=N]` fault point, or a real staging
-        error) backs off exponentially; the partition serves from the
-        host rung until a retry succeeds."""
+    def _stage_token(self, part: Partition):
+        """The staged-set membership token. Content-signature form when
+        the driver exposes one (a signature change is exactly an
+        obsolete staging); legacy plan-generation form otherwise."""
+        driver = getattr(self.client, "_driver", None)
+        sig_fn = getattr(driver, "subset_signature", None)
+        if sig_fn is not None:
+            try:
+                return (
+                    part.subset, part.device,
+                    sig_fn(self.target, part.subset),
+                )
+            except Exception:
+                pass
+        return (self._plan_gen, part.index, part.device)
+
+    def _subset_ready(self, part: Partition) -> bool:
+        """Can `part` serve a fused dispatch without staging work? A
+        driver without the surface (or without a device kernel) has
+        nothing to stage — always ready."""
+        driver = getattr(self.client, "_driver", None)
+        fn = getattr(driver, "subset_ready", None)
+        if fn is None:
+            return True
+        try:
+            return bool(fn(self.target, part.subset))
+        except Exception:
+            return True
+
+    def ensure_staged(self, part: Partition, wait: bool = True) -> bool:
+        """Stage `part`'s sub-program on its current device before a
+        fused dispatch. A restage failure (the `driver.restage[device=N]`
+        fault point, or a real staging error) backs off exponentially;
+        the partition serves from the host rung until a retry succeeds.
+
+        `wait=False` (the admission hot path): a partition that has
+        ALREADY served fused but whose sub-program content churned
+        restages in the BACKGROUND — the batch in hand routes to the
+        host rung (correct verdicts, not a degraded dispatch) while the
+        shadow sub-program compiles and swaps (docs/compile.md). A
+        never-staged partition still stages synchronously even with
+        wait=False: cold start must produce fused dispatches, not a
+        host stampede."""
         now = self._clock()
+        # token computed OUTSIDE the dispatcher lock: the signature read
+        # takes the driver mutex, which a concurrent dispatch may hold
+        # for a while — never stack this lock under that wait
+        token = self._stage_token(part)
         with self._lock:
-            token = (self._plan_gen, part.index, part.device)
             if token in self._staged:
                 return True
             if now < self._retry_at.get(part.device, 0.0):
                 return False
+            staged_before = part.index in self._staged_parts
+        if not wait and staged_before and not self._subset_ready(part):
+            self._spawn_restage(part)
+            return False
+        return self._stage_sync(part, now)
+
+    def _stage_sync(self, part: Partition, now: float) -> bool:
         prep = getattr(self.client, "prepare_subset", None)
         try:
+            ok = True
             if prep is not None:
-                prep(part.subset, device=part.device)
+                ok = prep(part.subset, device=part.device)
         except Exception:
-            with self._lock:
-                back = self._backoff.get(
-                    part.device, self.restage_backoff_s
-                )
-                self._retry_at[part.device] = now + back
-                self._backoff[part.device] = min(
-                    back * 2, self.restage_backoff_max_s
-                )
-                self.restage_failures += 1
-            if self.metrics is not None:
-                self.metrics.record(
-                    "device_partition_restage_failures_total", 1,
-                    plane=self.plane, device=str(part.device),
-                )
+            self._note_restage_failure(part, now)
             return False
+        if ok is False:
+            # lost a race with newer churn: not a failure (no backoff),
+            # but not staged either — the next pass sees the new content
+            return False
+        token = self._stage_token(part)
         with self._lock:
             self._staged.add(token)
+            self._staged_parts.add(part.index)
             self._retry_at.pop(part.device, None)
             self._backoff.pop(part.device, None)
         return True
+
+    def _note_restage_failure(self, part: Partition, now: float) -> None:
+        with self._lock:
+            back = self._backoff.get(
+                part.device, self.restage_backoff_s
+            )
+            self._retry_at[part.device] = now + back
+            self._backoff[part.device] = min(
+                back * 2, self.restage_backoff_max_s
+            )
+            self.restage_failures += 1
+            backlog = len(self._staging)
+        if self.metrics is not None:
+            self.metrics.record(
+                "device_partition_restage_failures_total", 1,
+                plane=self.plane, device=str(part.device),
+            )
+        # restage-failure bursts are the compile_storm trigger signal
+        note = getattr(self.recorder, "note_restage_failure", None)
+        if note is not None:
+            try:
+                note(self.plane, backlog=backlog)
+            except Exception:
+                pass
+
+    def _spawn_restage(self, part: Partition) -> None:
+        """Background restage of a churned, previously-fused partition.
+        NON-daemon thread: a daemon killed mid-XLA-compile at teardown
+        aborts the process (see TpuDriver._kick_warm); these threads
+        finish on their own — staging is bounded by one compile."""
+        key = (part.subset, part.device)
+        with self._lock:
+            if self._closed or key in self._staging:
+                return
+            self._staging.add(key)
+
+        def run():
+            try:
+                self._stage_sync(part, self._clock())
+            except Exception:
+                pass
+            finally:
+                with self._lock:
+                    self._staging.discard(key)
+
+        threading.Thread(
+            target=run, name=f"gk-restage-{self.plane}", daemon=False
+        ).start()
 
     # -- probes ----------------------------------------------------------------
 
@@ -762,6 +885,83 @@ class PartitionDispatcher:
                 })
         return doc
 
+    def programs_table(self) -> Dict[str, Any]:
+        """/debug/programs: the compile plane's live view — per
+        partition the sub-program content signature, staged/ready
+        state and in-flight restage, plus the driver's compile-plane
+        counters and program-store stats (hit/miss/rejected, swap
+        generation) — replica-tagged like /debug/partitions. Also the
+        flight recorder's `programs` source, so a compile_storm
+        postmortem carries the store state table."""
+        try:
+            plan = self.plan()
+        except Exception:
+            with self._lock:
+                plan = self._plan
+        driver = getattr(self.client, "_driver", None)
+        doc: Dict[str, Any] = {
+            "plane": self.plane,
+            "partitions": [],
+        }
+        if self.replica:
+            doc["replica"] = self.replica
+        stats_fn = getattr(driver, "compile_plane_stats", None)
+        if stats_fn is not None:
+            try:
+                doc["compile_plane"] = stats_fn()
+            except Exception:
+                pass
+        store = getattr(driver, "program_store", None)
+        if store is not None:
+            try:
+                doc["store_table"] = store.table()
+            except Exception:
+                pass
+        sig_fn = getattr(driver, "subset_signature", None)
+        ready_fn = getattr(driver, "subset_ready", None)
+        with self._lock:
+            staged = set(self._staged)
+            staging = set(self._staging)
+            staged_parts = set(self._staged_parts)
+            doc["restage_failures"] = self.restage_failures
+            doc["staging_in_flight"] = len(staging)
+        if plan is not None:
+            for p in plan.partitions:
+                sig = ready = None
+                if sig_fn is not None:
+                    try:
+                        sig = sig_fn(self.target, p.subset)
+                    except Exception:
+                        sig = None
+                if ready_fn is not None:
+                    try:
+                        ready = bool(ready_fn(self.target, p.subset))
+                    except Exception:
+                        ready = None
+                doc["partitions"].append({
+                    "index": p.index,
+                    "device": p.device,
+                    "constraints": len(p.keys),
+                    "signature": sig,
+                    "ready": ready,
+                    "staged": any(
+                        (
+                            isinstance(t[0], frozenset)
+                            and t[0] == p.subset
+                            and t[1] == p.device
+                        )
+                        or (
+                            not isinstance(t[0], frozenset)
+                            and t[1] == p.index
+                            and t[2] == p.device
+                        )
+                        for t in staged
+                    ),
+                    "staging_in_flight": (p.subset, p.device) in staging,
+                    "ever_staged": p.index in staged_parts,
+                })
+        return doc
+
     def snapshot(self) -> Dict[str, Any]:
         """Readyz/debug view: the plan, quarantine state, per-device
         breaker snapshots (keyed by breaker NAME), and dispatch/rehome/
@@ -786,4 +986,5 @@ class PartitionDispatcher:
                 "rehomes": self.rehomes,
                 "probes": self.probes,
                 "restage_failures": self.restage_failures,
+                "staging_in_flight": len(self._staging),
             }

@@ -489,6 +489,9 @@ class SoakHarness:
             )
             rep.partitioner = disp
             rep.recorder.add_source("partitions", disp.postmortem)
+            # compile_storm postmortems capture the program-store state
+            # table + per-partition signatures (docs/compile.md)
+            rep.recorder.add_source("programs", disp.programs_table)
             rep.server.partitioner = disp  # server.stop() closes it
             rep.server.batcher.partitioner = disp
             rep.server.batcher.breaker = None
@@ -645,6 +648,25 @@ class SoakHarness:
                 rep.client.add_constraint(
                     _constraint(kind, f"churn-t{n}", match=_POD_MATCH)
                 )
+        elif action == "ingest_wave":
+            # template ingest burst (docs/compile.md): `count` new
+            # template kinds + constraints land while traffic flows.
+            # Each new kind compiles exactly once; signature-unchanged
+            # partitions carry forward and churned ones restage in the
+            # background — the `ingest_zero_degraded` report check pins
+            # zero degraded dispatches and zero 5xx through the wave.
+            count = int(params.get("count", 500))
+            for _ in range(count):
+                n = next(self._churn_n)
+                kind = f"SoakChurn{n}"
+                rego = _CHURN_REGO.format(n=n)
+                for rep in self.replicas:
+                    rep.client.add_template(
+                        _template(kind, K8S_TARGET, rego)
+                    )
+                    rep.client.add_constraint(
+                        _constraint(kind, f"wave-t{n}", match=_POD_MATCH)
+                    )
         elif action == "add_provider":
             n = next(self._churn_n)
             for rep in self.replicas:
@@ -774,6 +796,8 @@ class SoakHarness:
         dec_recorded = dec_dropped = dec_sampled = dec_ring = 0
         dec_routes: Dict[str, int] = {}
         pt_p50 = pt_max = None  # pruned-dispatch width across replicas
+        degraded = 0  # webhook_degraded_dispatch_total across planes
+        program_swaps = program_carryforwards = program_compiles = 0
         for rep in self.replicas:
             for b in (
                 rep.server.batcher,
@@ -812,6 +836,26 @@ class SoakHarness:
                 dec_ring += dsnap["retained"]
                 for route, n in dsnap["routes"].items():
                     dec_routes[route] = dec_routes.get(route, 0) + n
+            # degraded dispatches (breaker-open / all-dead host
+            # routing): the ingest_zero_degraded check's evidence —
+            # host-rung routing during a background restage does NOT
+            # count here, only genuine degradation does
+            try:
+                counters = rep.metrics.snapshot()["counters"]
+            except Exception:
+                counters = {}
+            degraded += sum(
+                v for k, v in counters.items()
+                if k.startswith("webhook_degraded_dispatch_total")
+            )
+            drv = rep.driver
+            program_swaps += int(getattr(drv, "subset_swaps", 0) or 0)
+            program_carryforwards += int(
+                getattr(drv, "subset_carryforwards", 0) or 0
+            )
+            program_compiles += int(
+                getattr(drv, "program_compiles", 0) or 0
+            )
             if rep.partitioner is not None:
                 # pruning width (mask-gated partition skipping): p50/
                 # max partitions touched per batch over the recent
@@ -846,6 +890,10 @@ class SoakHarness:
             "decision_routes_cum": dec_routes,
             "partitions_touched_p50": pt_p50,
             "partitions_touched_max": pt_max,
+            "degraded_cum": degraded,
+            "program_swaps_cum": program_swaps,
+            "program_carryforwards_cum": program_carryforwards,
+            "program_compiles_cum": program_compiles,
         }
 
     def _sampler_loop(self) -> None:
@@ -904,6 +952,23 @@ class SoakHarness:
                 ),
                 "partitions_touched_max": (
                     cur["partitions_touched_max"]
+                ),
+                # compile plane (docs/compile.md): degraded dispatches
+                # this window (the ingest check's evidence), plus the
+                # swap/carry-forward/compile activity behind the wave
+                "degraded_dispatches": (
+                    cur["degraded_cum"] - prev["degraded_cum"]
+                ),
+                "program_swaps": (
+                    cur["program_swaps_cum"] - prev["program_swaps_cum"]
+                ),
+                "program_carryforwards": (
+                    cur["program_carryforwards_cum"]
+                    - prev["program_carryforwards_cum"]
+                ),
+                "program_compiles": (
+                    cur["program_compiles_cum"]
+                    - prev["program_compiles_cum"]
                 ),
             })
             prev = cur

@@ -129,6 +129,21 @@ def aggregate_phases(windows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
                 w.get("breaker_transitions", 0) for w in ws
             ),
             "fetches": sum(w.get("fetches", 0) for w in ws),
+            # compile plane (docs/compile.md): degraded dispatches in
+            # the phase (ingest_zero_degraded's evidence) + the swap/
+            # carry-forward/compile activity the churn drove
+            "degraded_dispatches": sum(
+                w.get("degraded_dispatches", 0) or 0 for w in ws
+            ),
+            "program_swaps": sum(
+                w.get("program_swaps", 0) or 0 for w in ws
+            ),
+            "program_carryforwards": sum(
+                w.get("program_carryforwards", 0) or 0 for w in ws
+            ),
+            "program_compiles": sum(
+                w.get("program_compiles", 0) or 0 for w in ws
+            ),
         })
     return out
 
@@ -228,6 +243,16 @@ def build_checks(
     if churn:
         checks["churn_zero_5xx"] = (
             churn["http_5xx"] == 0 and churn["transport_errors"] == 0
+        )
+    ingest = by_name.get("ingest")
+    if ingest:
+        # the zero-downtime warm-swap contract (docs/compile.md): a
+        # template ingest wave serves every request — fused or host
+        # rung — with zero degraded dispatches and zero 5xx while the
+        # new sub-programs compile on the shadow slot and swap live
+        checks["ingest_zero_degraded"] = (
+            ingest.get("degraded_dispatches", 0) == 0
+            and ingest["http_5xx"] == 0
         )
     kill = by_name.get("kill")
     if kill and kill["requests"]:

@@ -60,6 +60,12 @@ ACTIONS: Dict[str, tuple] = {
     # so sampler windows show partitions_touched well under the plan's
     # k while the cold group's partitions sit mask-skipped
     "locality_churn": (),
+    # incremental compile plane (docs/compile.md): a burst of new
+    # templates + constraints lands at once; every new partition
+    # shadow-stages and warm-swaps while in-flight batches keep the
+    # old programs — the ingest_zero_degraded check asserts the phase
+    # recorded zero degraded dispatches and zero 5xx
+    "ingest_wave": (),           # count (default 500): template burst
     "arm_fault": ("point",),     # mode/count/after/delay ride along
     "disarm_faults": (),         # reset the whole fault registry
     "rotate_certs": (),          # force a cert rotation (tls only)
@@ -243,7 +249,7 @@ def smoke_scenario() -> Scenario:
     minutes of wall clock."""
     return Scenario.from_dict({
         "name": "soak-smoke",
-        "duration_s": 10.0,
+        "duration_s": 12.5,
         "rps": 30.0,
         "deadline_s": 0.5,
         "window_s": 1.0,
@@ -272,6 +278,10 @@ def smoke_scenario() -> Scenario:
             # fault phase; recovery is judged from t=7 so it measures
             # the recovered system, not the queue flush
             {"at": 7.0, "action": "phase", "name": "recovery"},
+            # a small template ingest wave: the compile plane must
+            # serve every request through it (ingest_zero_degraded)
+            {"at": 9.0, "action": "phase", "name": "ingest"},
+            {"at": 9.2, "action": "ingest_wave", "count": 6},
         ],
     })
 
@@ -288,8 +298,9 @@ def default_scenario() -> Scenario:
     SLO must degrade and then recover post-disarm), a sick-chip window
     (ONE device of the 4-partition plan faulted: only its constraint
     subset degrades, then the operator quarantine/heal path re-homes
-    it), a live cert rotation, and a graceful replica kill that
-    replica B absorbs."""
+    it), a live cert rotation, a 500-template ingest wave that the
+    incremental compile plane must absorb with zero degraded
+    dispatches, and a graceful replica kill that replica B absorbs."""
     return Scenario.from_dict({
         "name": "soak-default",
         "duration_s": 150.0,
@@ -352,7 +363,13 @@ def default_scenario() -> Scenario:
             {"at": 114.5, "action": "quarantine_device", "device": 1},
             {"at": 117.0, "action": "heal_device", "device": 1},
             {"at": 118.0, "action": "rotate_certs"},
-            {"at": 120.0, "action": "phase", "name": "kill"},
-            {"at": 121.0, "action": "kill_replica", "replica": 0},
+            # 500-template ingest wave against the 4-partition plan:
+            # every changed partition shadow-compiles off the serving
+            # path and warm-swaps — the ingest_zero_degraded check
+            # demands zero degraded dispatches and zero 5xx here
+            {"at": 119.0, "action": "phase", "name": "ingest"},
+            {"at": 119.5, "action": "ingest_wave", "count": 500},
+            {"at": 135.0, "action": "phase", "name": "kill"},
+            {"at": 136.0, "action": "kill_replica", "replica": 0},
         ],
     })

@@ -1,7 +1,8 @@
 """The bench SUMMARY line contract, shared by every lane.
 
 Every bench entry point (`bench_webhook.py --ladder/--attribution/
---partitions/--fleet/--chaos/--external/--mutate/--soak`, `bench.py`)
+--partitions/--fleet/--chaos/--churn/--external/--mutate/--soak`,
+`bench.py`)
 ends its run with one compact driver-parseable line:
 
     SUMMARY: {"mode": "<lane>", ...headline numbers...}
@@ -57,6 +58,10 @@ REQUIRED_FIELDS: Dict[str, tuple] = {
         "fetches_per_key_n2_fleet", "cold_fetch_amplification",
     ),
     "chaos": ("phases", "p50_ms", "p99_ms", "shed_rate"),
+    "churn": (
+        "waves", "ingest_to_serve_ms", "degraded_dispatches",
+        "http_5xx",
+    ),
     "external": ("phases", "cache_hit_rate", "fetches_per_batch"),
     "mutate": ("p50_ms", "p99_ms", "throughput_rps"),
     "soak": (

@@ -514,6 +514,17 @@ class MicroBatcher:
             resp = responses.by_target.get(self.target)
             fut.set_result(resp.results if resp is not None else [])
 
+    @staticmethod
+    def _ensure_staged_nowait(part, p) -> bool:
+        """ensure_staged without blocking the admission batch on a
+        compile: churned sub-programs restage in the background while
+        this batch serves from the host rung (docs/compile.md). The
+        TypeError fallback keeps older/duck-typed dispatchers working."""
+        try:
+            return part.ensure_staged(p, wait=False)
+        except TypeError:
+            return part.ensure_staged(p)
+
     def _dispatch_partitioned(self, batch, reviews, plan,
                               wall0: float, t0: float) -> None:
         """Fault-domain dispatch (docs/robustness.md §Fault domains):
@@ -587,9 +598,12 @@ class MicroBatcher:
                         plane=self.plane,
                     )
                 host_parts.append(p)
-            elif not part.ensure_staged(p):
-                # restage (re-home) not complete: host rung until the
-                # backoff-gated retry lands
+            elif not self._ensure_staged_nowait(part, p):
+                # restage not complete (re-home backoff, or a churned
+                # sub-program compiling in the background,
+                # docs/compile.md): host rung — correct verdicts from
+                # the interpreter, NOT a degraded dispatch — until the
+                # swap lands
                 host_parts.append(p)
             else:
                 fused.append((p, br))

@@ -67,6 +67,17 @@ MODE_RESULTS = {
             "throughput_rps": 400.0, "shed_rate": 0.0,
         }],
     },
+    "churn": {
+        "partitions": 4,
+        "waves": [{
+            "wave": 10, "ingest_to_serve_ms": 120.0,
+            "degraded_dispatches": 0, "http_5xx": 0,
+            "compiles": 10, "swaps": 4,
+        }],
+        "ingest_to_serve_ms": 120.0,
+        "degraded_dispatches": 0, "http_5xx": 0,
+        "compiles": 10, "swaps": 4,
+    },
     "external": {
         "phases": [{
             "phase": "warm_deny", "p50_ms": 2.0, "p99_ms": 6.0,
@@ -101,7 +112,7 @@ def test_contract_covers_every_bench_mode_flag():
     with open(bench_webhook.__file__) as f:
         src = f.read()
     for mode in ("ladder", "attribution", "partitions", "fleet",
-                 "chaos", "external", "mutate", "soak"):
+                 "chaos", "churn", "external", "mutate", "soak"):
         assert f'"--{mode}"' in src, f"bench flag --{mode} vanished?"
         assert mode in REQUIRED_FIELDS, f"mode {mode!r} unregistered"
     assert "webhook" in REQUIRED_FIELDS  # the default (flagless) lane
