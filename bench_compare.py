@@ -54,6 +54,12 @@ WATCHED: Dict[str, int] = {
     "throughput_rps": -1,
     "slo_attainment": -1,
     "cache_hit_rate": -1,
+    # corpus static analysis (ISSUE 15): fewer statically-excluded
+    # dead rows = the corpus pass stopped proving the seeded dead
+    # constraints (pruning regression); more corpus diagnostics = new
+    # cross-plane findings in the bench corpus
+    "rows_excluded_static": -1,
+    "corpus_diagnostics": +1,
 }
 
 # context keys that make a row's path stable across runs (rungs and

@@ -1327,7 +1327,7 @@ violation[{"msg": msg}] {
 """
 
 
-def build_attribution_client(driver, n_constraints):
+def build_attribution_client(driver, n_constraints, n_dead=0):
     """Self-contained policy load for the --attribution lane (no
     reference-library dependency): three templates of DIFFERENT static
     cost — a one-clause privileged check, a set-difference label check,
@@ -1369,6 +1369,27 @@ def build_attribution_client(driver, n_constraints):
             "apiVersion": "constraints.gatekeeper.sh/v1beta1",
             "kind": kind,
             "metadata": {"name": f"a{i:04d}"},
+            "spec": spec,
+        })
+    # provably-dead rows for the static-pruning lane: namespaces fully
+    # excluded (corpus dead-match proof, GK-C006) with no
+    # namespaceSelector, so the corpus pass marks them prunable and
+    # the planner drops the rows before partitioning —
+    # rows_excluded_static in the rung must equal n_dead
+    for i in range(n_dead):
+        kind, _rego, params = mix[i % len(mix)]
+        spec = {"match": {
+            "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+            "scope": "Namespaced",
+            "namespaces": ["ns-dead"],
+            "excludedNamespaces": ["ns-dead"],
+        }}
+        if params is not None:
+            spec["parameters"] = params
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind,
+            "metadata": {"name": f"dead{i:02d}"},
             "spec": spec,
         })
     return client
@@ -1413,6 +1434,7 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
     within 10%; the model changes WHO is charged, never HOW MUCH).
     `--profile` additionally captures a JAX/XPlane device profile
     DURING the largest rung's measured replay."""
+    from gatekeeper_tpu.analysis.corpus import CorpusPlane
     from gatekeeper_tpu.constraint import TpuDriver
     from gatekeeper_tpu.control.runner import capture_jax_profile
     from gatekeeper_tpu.metrics import MetricsRegistry
@@ -1432,7 +1454,14 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
         driver.set_metrics(metrics)
         attributor = CostAttributor(metrics=metrics)
         driver.set_attributor(attributor)
-        client = build_attribution_client(driver, n_con)
+        client = build_attribution_client(driver, n_con, n_dead=3)
+        # corpus plane: the verdict-safe static-pruning input — the
+        # seeded dead rows are proved dead once, synchronously, before
+        # the measured replays (production recomputes on churn; the
+        # bench corpus is static after load)
+        corpus_plane = CorpusPlane(client, metrics=metrics,
+                                   debounce_s=0.0)
+        corpus_report = corpus_plane.refresh()
         # tracing is always-on in production and the decision plane
         # joins its dispatch facts by trace id — both ride every
         # measured rung (the ≤5% p50 overhead budget is measured below
@@ -1447,7 +1476,7 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
         k_rung = min(n_con, max(k, n_con // 8), 64)
         disp = PartitionDispatcher(
             client, TARGET, k=k_rung, metrics=metrics,
-            tracer=tracer, attributor=attributor,
+            tracer=tracer, attributor=attributor, corpus=corpus_plane,
         )
         batcher = MicroBatcher(
             client, TARGET, window_ms=2.0, metrics=metrics,
@@ -1506,6 +1535,10 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
                 and abs(attributed - measured) <= 0.10 * measured
             )
             touched = disp.touched_stats()
+            plan_now = disp.plan()
+            rows_excluded = len(
+                getattr(plan_now, "excluded_static", ()) or ()
+            )
             rung = {
                 "constraints": n_con,
                 "partitions": k_rung,
@@ -1535,6 +1568,13 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
                     round(rows_dispatched / rows_total, 4)
                     if rows_total else None
                 ),
+                # verdict-safe static pruning (corpus pass): provably-
+                # dead rows the planner excluded before partitioning,
+                # and the corpus diagnostic count backing the proof
+                "rows_excluded_static": rows_excluded,
+                "corpus_diagnostics": sum(
+                    (corpus_report.counts() or {}).values()
+                ),
                 "decisions": decisions.snapshot(),
                 "top_costs": top,
             }
@@ -1556,6 +1596,7 @@ def run_attribution_bench(rungs=(10, 50, 200), n_requests=1200, k=4,
                 f"{measured:.4f}s attributed={attributed:.4f}s "
                 f"sums_ok={sums_ok} "
                 f"dispatch_efficiency={rung['dispatch_efficiency']} "
+                f"rows_excluded_static={rows_excluded} "
                 f"top={top3}",
                 file=err,
             )
@@ -1937,6 +1978,18 @@ def _summarize(mode, res):
             }
             head["partitions_touched_max"] = {
                 str(r["constraints"]): r.get("partitions_touched_max")
+                for r in rungs
+            }
+            # verdict-safe static pruning per rung: dead rows the
+            # planner dropped (down = regression: the corpus pass
+            # stopped proving the seeded dead rows) and the corpus
+            # diagnostic count (up = new corpus findings)
+            head["rows_excluded_static"] = {
+                str(r["constraints"]): r.get("rows_excluded_static")
+                for r in rungs
+            }
+            head["corpus_diagnostics"] = {
+                str(r["constraints"]): r.get("corpus_diagnostics")
                 for r in rungs
             }
             if rungs:

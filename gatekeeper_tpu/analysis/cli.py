@@ -1,4 +1,4 @@
-"""Offline template + mutator + provider linting CLI.
+"""Offline template + mutator + provider + corpus linting CLI.
 
     python -m gatekeeper_tpu.analysis deploy/ [more paths...]
         [--json] [--baseline FILE] [--write-baseline FILE] [--strict]
@@ -6,6 +6,9 @@
         [--json] [--baseline FILE] [--write-baseline FILE]
     python -m gatekeeper_tpu.analysis providers deploy/ [more paths...]
         [--json] [--baseline FILE] [--write-baseline FILE]
+    python -m gatekeeper_tpu.analysis corpus deploy/ [more paths...]
+        [--json] [--baseline FILE] [--write-baseline FILE]
+    python -m gatekeeper_tpu.analysis all [deploy/policies]
 
 Default mode scans the given files/directories for ConstraintTemplate
 YAML documents (directories recurse over *.yaml / *.yml; explicit
@@ -25,19 +28,31 @@ documents and reports spec problems with stable GK-P0xx codes
 fail-open providers with no cache to fall back on. Baseline manifest:
 {"providers": {id: [codes]}}.
 
-Exit status:
-  0  every template analyzed, no INVALID verdicts, no baseline
-     regressions
-  1  an INVALID template, a baseline regression (a template whose
-     recorded verdict was better than the current one), or --strict
-     with any template below VECTORIZED
-  2  usage / no templates found
+`corpus` mode runs the whole-corpus cross-plane pass (GK-C0xx,
+docs/analysis.md §Corpus analysis) over every template, constraint,
+mutator and Provider found under the given paths together: missing
+providers, orphan constraints, parameter/schema mismatches, dead and
+shadowed matches, mutate↔validate admission fights. Baseline
+manifest: {"corpus": {subject: [codes]}}.
 
-`--baseline FILE` compares against a checked-in manifest (JSON:
-{"templates": {kind: verdict}}) so CI pins the library's vectorization
-coverage; `--write-baseline FILE` (re)generates it. New templates (not
-in the manifest) are allowed; a verdict *improvement* is reported but
-passes — refresh the baseline to lock it in.
+`all` mode is the one-shot repo gate: templates + mutators +
+providers + corpus over one directory (default `deploy/policies`),
+each compared against its conventional checked-in baseline when
+present (`analysis-baseline.json`, `mutators-baseline.json`,
+`providers-baseline.json`, `corpus-baseline.json` in that directory),
+folded into a single exit code.
+
+Shared contract across all subcommands (normalized in PR 15 — they
+had grown ad hoc per PR):
+  * `--baseline FILE` compares against a checked-in manifest; new
+    subjects (absent from the manifest) are allowed; a subject gaining
+    a code (or, for templates, a worse verdict) fails.
+  * Without a baseline, ANY diagnostic fails (pure lint mode).
+  * `--write-baseline FILE` (re)generates the manifest (sorted,
+    trailing newline) regardless of pass/fail.
+  * Exit status: 0 clean / baseline-clean; 1 failures; 2 usage or
+    nothing found to lint (`all` only exits 2 when NO plane found
+    anything).
 """
 
 from __future__ import annotations
@@ -109,6 +124,86 @@ def _worse(a: str, b: str) -> bool:
     return VERDICT_ORDER.index(a) > VERDICT_ORDER.index(b)
 
 
+# ---------------------------------------------------------------------------
+# shared baseline/report plumbing (one contract for every code-lint mode)
+
+
+def _load_code_baseline(path: str, key: str) -> Dict[str, List[str]]:
+    with open(path) as f:
+        return (json.load(f) or {}).get(key, {})
+
+
+def _compare_code_baseline(lints, baseline: Dict[str, List[str]]
+                           ) -> List[str]:
+    """New-code regressions vs a manifest; new subjects are allowed."""
+    failures: List[str] = []
+    for lint in lints:
+        want = baseline.get(lint.id)
+        if want is None:
+            continue  # new subject: allowed
+        new_codes = sorted(set(lint.codes) - set(want))
+        if new_codes:
+            failures.append(
+                f"{lint.id}: new diagnostics vs baseline: "
+                f"{', '.join(new_codes)}"
+            )
+    return failures
+
+
+def _lint_failures(lints) -> List[str]:
+    """No-baseline mode: any diagnostic is a failure."""
+    return [lint.render() for lint in lints if not lint.ok]
+
+
+def _write_code_baseline(path: str, key: str, lints) -> None:
+    manifest = {key: {lint.id: sorted(lint.codes) for lint in lints}}
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _emit_code_lints(args, key: str, noun: str, lints,
+                     failures: List[str]) -> None:
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    key: [lint.to_dict() for lint in lints],
+                    "failures": failures,
+                },
+                indent=2,
+            )
+        )
+        return
+    for lint in lints:
+        print(f"[{lint.source}] {lint.render()}")
+    if failures:
+        print("\nFAIL:", file=sys.stderr)
+        for f_ in failures:
+            print(f"  {f_}", file=sys.stderr)
+    else:
+        n_ok = sum(1 for lint in lints if lint.ok)
+        print(
+            f"\nOK: {len(lints)} {noun}(s): clean={n_ok} "
+            f"flagged={len(lints) - n_ok}"
+        )
+
+
+def _run_code_lints(args, key: str, noun: str, lints) -> int:
+    """The shared tail of every code-lint subcommand: baseline compare
+    (or pure lint), optional manifest write, report, exit code."""
+    if args.baseline:
+        failures = _compare_code_baseline(
+            lints, _load_code_baseline(args.baseline, key)
+        )
+    else:
+        failures = _lint_failures(lints)
+    if args.write_baseline:
+        _write_code_baseline(args.write_baseline, key, lints)
+    _emit_code_lints(args, key, noun, lints, failures)
+    return 1 if failures else 0
+
+
 def _iter_mutator_docs(path: str):
     import yaml
 
@@ -162,63 +257,8 @@ def run_mutators(argv: List[str]) -> int:
         print("no mutators found", file=sys.stderr)
         return 2
 
-    lints = lint_mutators(entries)
-
-    failures: List[str] = []
-    baseline: Dict[str, List[str]] = {}
-    if args.baseline:
-        with open(args.baseline) as f:
-            baseline = (json.load(f) or {}).get("mutators", {})
-        for lint in lints:
-            want = baseline.get(lint.id)
-            if want is None:
-                continue  # new mutator: allowed
-            new_codes = sorted(set(lint.codes) - set(want))
-            if new_codes:
-                failures.append(
-                    f"{lint.id}: new diagnostics vs baseline: "
-                    f"{', '.join(new_codes)}"
-                )
-    else:
-        # no baseline: any diagnostic is a failure (lint mode)
-        for lint in lints:
-            if not lint.ok:
-                failures.append(lint.render())
-
-    if args.write_baseline:
-        manifest = {
-            "mutators": {
-                lint.id: sorted(lint.codes) for lint in lints
-            }
-        }
-        with open(args.write_baseline, "w") as f:
-            json.dump(manifest, f, indent=2, sort_keys=True)
-            f.write("\n")
-
-    if args.json:
-        print(
-            json.dumps(
-                {
-                    "mutators": [lint.to_dict() for lint in lints],
-                    "failures": failures,
-                },
-                indent=2,
-            )
-        )
-    else:
-        for lint in lints:
-            print(f"[{lint.source}] {lint.render()}")
-        if failures:
-            print("\nFAIL:", file=sys.stderr)
-            for f_ in failures:
-                print(f"  {f_}", file=sys.stderr)
-        else:
-            n_ok = sum(1 for lint in lints if lint.ok)
-            print(
-                f"\nOK: {len(lints)} mutator(s): clean={n_ok} "
-                f"flagged={len(lints) - n_ok}"
-            )
-    return 1 if failures else 0
+    return _run_code_lints(args, "mutators", "mutator",
+                           lint_mutators(entries))
 
 
 def _iter_provider_docs(path: str):
@@ -278,61 +318,142 @@ def run_providers(argv: List[str]) -> int:
         print("no Providers found", file=sys.stderr)
         return 2
 
-    lints = lint_providers(entries)
+    return _run_code_lints(args, "providers", "provider",
+                           lint_providers(entries))
 
-    failures: List[str] = []
-    if args.baseline:
-        with open(args.baseline) as f:
-            baseline = (json.load(f) or {}).get("providers", {})
-        for lint in lints:
-            want = baseline.get(lint.id)
-            if want is None:
-                continue  # new provider: allowed
-            new_codes = sorted(set(lint.codes) - set(want))
-            if new_codes:
-                failures.append(
-                    f"{lint.id}: new diagnostics vs baseline: "
-                    f"{', '.join(new_codes)}"
-                )
-    else:
-        for lint in lints:
-            if not lint.ok:
-                failures.append(lint.render())
 
-    if args.write_baseline:
-        manifest = {
-            "providers": {
-                lint.id: sorted(lint.codes) for lint in lints
-            }
-        }
-        with open(args.write_baseline, "w") as f:
-            json.dump(manifest, f, indent=2, sort_keys=True)
-            f.write("\n")
+def _iter_constraint_docs(path: str):
+    import yaml
 
-    if args.json:
-        print(
-            json.dumps(
-                {
-                    "providers": [lint.to_dict() for lint in lints],
-                    "failures": failures,
-                },
-                indent=2,
-            )
-        )
-    else:
-        for lint in lints:
-            print(f"[{lint.source}] {lint.render()}")
-        if failures:
-            print("\nFAIL:", file=sys.stderr)
-            for f_ in failures:
-                print(f"  {f_}", file=sys.stderr)
+    from ..constraint.templates import CONSTRAINT_GROUP
+
+    with open(path) as f:
+        try:
+            docs = list(yaml.safe_load_all(f))
+        except yaml.YAMLError as e:
+            raise SystemExit(f"error: {path}: YAML parse error: {e}")
+    for doc in docs:
+        if isinstance(doc, dict) and str(
+            doc.get("apiVersion", "")
+        ).partition("/")[0] == CONSTRAINT_GROUP:
+            yield path, doc
+
+
+def collect_constraints(paths: List[str]) -> List[Tuple[str, Dict[str, Any]]]:
+    out: List[Tuple[str, Dict[str, Any]]] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for fn in sorted(files):
+                    if fn.endswith((".yaml", ".yml")):
+                        out.extend(
+                            _iter_constraint_docs(os.path.join(root, fn))
+                        )
+        elif p.endswith((".yaml", ".yml")):
+            out.extend(_iter_constraint_docs(p))
         else:
-            n_ok = sum(1 for lint in lints if lint.ok)
-            print(
-                f"\nOK: {len(lints)} provider(s): clean={n_ok} "
-                f"flagged={len(lints) - n_ok}"
-            )
-    return 1 if failures else 0
+            raise SystemExit(f"error: unsupported path {p!r}")
+    return out
+
+
+def run_corpus(argv: List[str]) -> int:
+    """`corpus` mode: whole-corpus GK-C0xx pass + baseline
+    enforcement (docs/analysis.md §Corpus analysis)."""
+    from .corpus import corpus_from_docs
+
+    ap = argparse.ArgumentParser(
+        prog="python -m gatekeeper_tpu.analysis corpus",
+        description=(
+            "Whole-corpus cross-plane linter (templates + constraints "
+            "+ mutators + Providers together)"
+        ),
+    )
+    ap.add_argument("paths", nargs="+", help="policy YAML files or dirs")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--baseline", help="code manifest to compare against")
+    ap.add_argument(
+        "--write-baseline", help="write the current codes to FILE"
+    )
+    args = ap.parse_args(argv)
+
+    template_docs = [
+        (src, doc)
+        for src, doc in collect_templates(args.paths)
+        if isinstance(doc, dict)  # bare .rego has no corpus identity
+    ]
+    constraint_docs = collect_constraints(args.paths)
+    mutator_docs = collect_mutators(args.paths)
+    provider_docs = collect_providers(args.paths)
+    if not (template_docs or constraint_docs or mutator_docs
+            or provider_docs):
+        print("no policy documents found", file=sys.stderr)
+        return 2
+
+    report = corpus_from_docs(
+        template_docs, constraint_docs, mutator_docs, provider_docs
+    )
+    # per-subject lints ride the shared baseline tail; the corpus-level
+    # rollup (dead/prunable/shadowed) prints alongside
+    flagged = [lint for lint in report.lints]
+    rc = _run_code_lints(args, "corpus", "subject", flagged)
+    if not args.json:
+        print(
+            f"corpus: dead={len(report.dead_keys)} "
+            f"prunable={len(report.prunable_keys)} "
+            f"shadowed={len(report.shadowed)}"
+        )
+    return rc
+
+
+def run_all(argv: List[str]) -> int:
+    """`all` mode: the one-shot repo gate. Runs templates + mutators +
+    providers + corpus over one directory against their conventional
+    baselines (when present) and folds the exit codes: any plane's
+    failure fails the gate; a plane with nothing to lint is skipped
+    (exit 2 only when NOTHING was found at all)."""
+    ap = argparse.ArgumentParser(
+        prog="python -m gatekeeper_tpu.analysis all",
+        description="Run every analysis plane against a policy tree",
+    )
+    ap.add_argument(
+        "path", nargs="?", default="deploy/policies",
+        help="policy tree (default deploy/policies)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.path):
+        print(f"error: not a directory: {args.path!r}", file=sys.stderr)
+        return 2
+
+    planes = [
+        ("templates", run, "analysis-baseline.json"),
+        ("mutators", run_mutators, "mutators-baseline.json"),
+        ("providers", run_providers, "providers-baseline.json"),
+        ("corpus", run_corpus, "corpus-baseline.json"),
+    ]
+    results: Dict[str, int] = {}
+    for name, fn, baseline_name in planes:
+        sub_argv = [args.path]
+        baseline = os.path.join(args.path, baseline_name)
+        if os.path.exists(baseline):
+            sub_argv += ["--baseline", baseline]
+        if args.json:
+            sub_argv.append("--json")
+        print(f"== {name} ==")
+        results[name] = fn(sub_argv)
+
+    ran = {n: rc for n, rc in results.items() if rc != 2}
+    print("\n== gate ==")
+    for name, _fn, _b in planes:
+        rc = results[name]
+        state = "SKIP (nothing found)" if rc == 2 else (
+            "OK" if rc == 0 else "FAIL"
+        )
+        print(f"  {name}: {state}")
+    if not ran:
+        print("nothing to lint", file=sys.stderr)
+        return 2
+    return 1 if any(rc == 1 for rc in ran.values()) else 0
 
 
 def run(argv: List[str]) -> int:
@@ -340,6 +461,10 @@ def run(argv: List[str]) -> int:
         return run_mutators(argv[1:])
     if argv and argv[0] == "providers":
         return run_providers(argv[1:])
+    if argv and argv[0] == "corpus":
+        return run_corpus(argv[1:])
+    if argv and argv[0] == "all":
+        return run_all(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m gatekeeper_tpu.analysis",
         description="Static vectorizability linter for ConstraintTemplates",

@@ -185,6 +185,14 @@ class Client:
                 ).items():
                     self._driver.put_data(f"/constraints/{target}/{subpath}", c)
             self._driver.put_modules(prefix, modules)
+            # re-attach the admission-time analyzer report: put_modules
+            # just dropped the driver's cached analysis for this kind
+            # (warm-swap invalidation), and without this hand-back the
+            # /readyz verdict and fallback routing provenance stayed
+            # blank until the next dispatch lazily re-analyzed
+            attach = getattr(self._driver, "attach_report", None)
+            if attach is not None:
+                attach(target, ct.kind, ct.vectorizability)
             self._templates[ct.name] = _TemplateEntry(
                 template=ct, crd=crd, targets=[target]
             )

@@ -494,6 +494,26 @@ class TpuDriver(RegoDriver):
         except Exception:
             pass  # accounting must never fail a dispatch
 
+    def attach_report(
+        self, target: str, kind: str, report: VectorizabilityReport
+    ) -> None:
+        """Re-attach the admission-time analyzer report after a module
+        swap. put_modules drops _analysis/_fallback_codes for the kind
+        (warm-swap invalidation) and nothing repopulated them until the
+        next dispatch lazily re-analyzed — so /readyz verdicts and the
+        fallback-code table went blank under churn. Client.add_template
+        hands its already-computed report straight back so the verdict
+        (and its routing provenance) survives the recompile window."""
+        if report is None:
+            return
+        with self._mutex:
+            self._analysis[(target, kind)] = report
+            if not report.compilable:
+                self._fallback_codes[(target, kind)] = (
+                    report.primary_code() or "GK-V007"
+                )
+        self._export_verdict(kind, report)
+
     def template_report(
         self, target: str, kind: str
     ) -> Optional[VectorizabilityReport]:

@@ -313,6 +313,17 @@ class Runner:
         set_ed = getattr(client, "set_external_data", None)
         if set_ed is not None:
             set_ed(self.external_data)
+        # corpus analysis plane (docs/analysis.md §Corpus analysis):
+        # whole-corpus diagnostics recomputed off the request path on
+        # churn, snapshot on /readyz, prunable keys fed to the planner
+        from ..analysis.corpus import CorpusPlane
+
+        self.corpus = CorpusPlane(
+            client,
+            mutation_system=self.mutation_system,
+            external_data=self.external_data,
+            metrics=metrics,
+        )
         self.provider_controller = ProviderController(
             self.external_data,
             switch=self.switch,
@@ -502,6 +513,7 @@ class Runner:
                 decision_log=self.decisions,
                 attributor=self.attributor,
                 replica=self.pod_name,
+                corpus=self.corpus,
             )
             # postmortem state sources: what a flight record snapshots
             # alongside the trace tail / cost table / fault points
@@ -935,6 +947,17 @@ class Runner:
                         "flightrecords": runner.recorder.snapshot(),
                         "decisions": runner.decisions.snapshot(),
                     }
+                    # corpus analysis headline (docs/analysis.md
+                    # §Corpus analysis): diagnostic counts + the
+                    # dead/prunable/shadowed rollup; recompute is
+                    # debounced + off-path, so this only reads the
+                    # cached report (and may kick a background pass)
+                    corpus = getattr(runner, "corpus", None)
+                    if corpus is not None:
+                        corpus.maybe_recompute()
+                        stats["analysis"] = {
+                            "corpus": corpus.snapshot()
+                        }
                     payload = json.dumps(
                         {"ready": ok, "stats": stats}
                     ).encode()

@@ -87,6 +87,10 @@ class PartitionPlan:
     order: Dict[str, int]
     devices: Tuple[int, ...]
     all_dead: bool = False
+    # constraint keys the corpus analyzer proved dead (and free of the
+    # ns-selector autoreject path) — excluded from every dispatch row;
+    # verdict-safe by the corpus parity battery (docs/analysis.md)
+    excluded_static: Tuple[str, ...] = ()
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -94,6 +98,7 @@ class PartitionPlan:
             "constraints": len(self.order),
             "devices": list(self.devices),
             "all_dead": self.all_dead,
+            "excluded_static": list(self.excluded_static),
             "partitions": [p.to_dict() for p in self.partitions],
         }
 
@@ -309,6 +314,10 @@ class PartitionDispatcher:
         attributor=None,
         # replica name stamped on /debug/partitions, like /debug/costs
         replica: Optional[str] = None,
+        # analysis.corpus.CorpusPlane: provably-dead constraint keys are
+        # excluded from dispatch rows (generation-matched; a stale or
+        # absent corpus report prunes nothing)
+        corpus=None,
     ):
         self.client = client
         self.target = target
@@ -333,6 +342,7 @@ class PartitionDispatcher:
         self.recorder = recorder
         self.attributor = attributor
         self.replica = replica
+        self.corpus = corpus
         self._lock = threading.RLock()
         self._touched: List[int] = []  # per-batch partitions touched
         self._plan_costs: Dict[str, Dict[str, float]] = {}
@@ -466,11 +476,24 @@ class PartitionDispatcher:
         healthy = frozenset(
             d for d in self.devices if self._device_healthy(d)
         )
-        key = (gen, healthy, frozenset(self._manual_quarantine))
+        excluded: frozenset = frozenset()
+        if self.corpus is not None and gen is not None:
+            try:
+                # generation-matched ask: a stale report answers empty
+                # (and kicks a debounced background recompute) — never
+                # blocks the planner, never prunes on stale proofs
+                excluded = frozenset(
+                    self.corpus.prunable_keys(self.target, gen)
+                )
+            except Exception:
+                excluded = frozenset()
+        key = (gen, healthy, frozenset(self._manual_quarantine), excluded)
         with self._lock:
             if self._plan is not None and self._plan_key == key:
                 return self._plan
         keys = keys_fn(self.target)
+        if excluded:
+            keys = [c for c in keys if c not in excluded]
         if not keys:
             with self._lock:
                 self._plan, self._plan_key = None, key
@@ -485,6 +508,7 @@ class PartitionDispatcher:
                 constraint_gen=gen, generation=self._plan_gen,
                 costs=blended, locality=locality,
             )
+            plan.excluded_static = tuple(sorted(excluded))
             self._plan_costs = {
                 "static": dict(static or {}),
                 "measured": dict(measured),
@@ -858,11 +882,24 @@ class PartitionDispatcher:
             measured = dict(self._plan_costs.get("measured", {}))
         s_total = sum(static.values())
         m_total = sum(v for v in measured.values() if v > 0.0)
+        # corpus-analysis flags: statically-excluded keys never appear
+        # in a partition row (that's the point), so they are listed at
+        # the table level; shadowed keys ride their row — both answer
+        # the postmortem question "why didn't this constraint fire"
+        shadowed: Dict[str, str] = {}
+        if self.corpus is not None:
+            try:
+                shadowed = dict(self.corpus.shadowed_keys())
+            except Exception:
+                shadowed = {}
         doc: Dict[str, Any] = {
             "plane": self.plane,
             "k": self.k,
             "generation": plan.generation if plan is not None else None,
             "all_dead": plan.all_dead if plan is not None else None,
+            "excluded_static": (
+                list(plan.excluded_static) if plan is not None else []
+            ),
             "partitions_touched": self.touched_stats(),
             "partitions": [],
         }
@@ -872,7 +909,7 @@ class PartitionDispatcher:
             for p in plan.partitions:
                 s = sum(static.get(k, 0.0) for k in p.keys)
                 m = sum(measured.get(k, 0.0) for k in p.keys)
-                doc["partitions"].append({
+                row = {
                     "index": p.index,
                     "home_device": p.home_device,
                     "device": p.device,
@@ -882,7 +919,13 @@ class PartitionDispatcher:
                         (s / s_total) if s_total > 0 else None,
                     "measured_cost_share":
                         (m / m_total) if m_total > 0 else None,
-                })
+                }
+                row_shadowed = {
+                    k: shadowed[k] for k in p.keys if k in shadowed
+                }
+                if row_shadowed:
+                    row["shadowed"] = row_shadowed
+                doc["partitions"].append(row)
         return doc
 
     def programs_table(self) -> Dict[str, Any]:

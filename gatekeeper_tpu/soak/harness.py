@@ -224,6 +224,7 @@ class _Replica:
         self.attributor = None  # per-constraint device-time accounting
         self.recorder = None  # trip-triggered postmortem capture
         self.decisions = None  # per-admission decision log
+        self.corpus = None  # corpus static-analysis plane
 
     @property
     def base_url(self) -> str:
@@ -378,6 +379,21 @@ class SoakHarness:
         )
         rep.mutation_system = MutationSystem(metrics=rep.metrics)
         rep.mutation_system.upsert(_assign_metadata("soak-base", "soak"))
+        from ..analysis.corpus import CorpusPlane
+
+        # corpus static-analysis plane (docs/analysis.md §Corpus
+        # analysis): recomputed in the background when churn moves the
+        # policy generation — the sampler's maybe_recompute() poll
+        # mirrors production's /readyz-driven kick, never the request
+        # path — and the partition planner consumes prunable_keys for
+        # verdict-safe static pruning
+        rep.corpus = CorpusPlane(
+            rep.client,
+            mutation_system=rep.mutation_system,
+            external_data=rep.external,
+            metrics=rep.metrics,
+        )
+        rep.corpus.refresh()
 
         rotator = None
         if scn.tls:
@@ -486,6 +502,7 @@ class SoakHarness:
                     _ledger_subscribe(b, "validation", replica)
                 ),
                 recorder=rep.recorder,
+                corpus=rep.corpus,
             )
             rep.partitioner = disp
             rep.recorder.add_source("partitions", disp.postmortem)
@@ -798,6 +815,7 @@ class SoakHarness:
         pt_p50 = pt_max = None  # pruned-dispatch width across replicas
         degraded = 0  # webhook_degraded_dispatch_total across planes
         program_swaps = program_carryforwards = program_compiles = 0
+        corpus_recomputes = 0  # corpus-analysis background refreshes
         for rep in self.replicas:
             for b in (
                 rep.server.batcher,
@@ -856,6 +874,17 @@ class SoakHarness:
             program_compiles += int(
                 getattr(drv, "program_compiles", 0) or 0
             )
+            if rep.corpus is not None:
+                # the sampler IS the recompute kick (production's
+                # /readyz poll): a generation-compare + time-compare,
+                # with the analysis itself on a background thread —
+                # churn waves trigger one debounced recompute, never
+                # one per add and never request-path work
+                try:
+                    rep.corpus.maybe_recompute()
+                    corpus_recomputes += int(rep.corpus.recomputes)
+                except Exception:
+                    pass
             if rep.partitioner is not None:
                 # pruning width (mask-gated partition skipping): p50/
                 # max partitions touched per batch over the recent
@@ -894,6 +923,7 @@ class SoakHarness:
             "program_swaps_cum": program_swaps,
             "program_carryforwards_cum": program_carryforwards,
             "program_compiles_cum": program_compiles,
+            "corpus_recomputes_cum": corpus_recomputes,
         }
 
     def _sampler_loop(self) -> None:
@@ -969,6 +999,13 @@ class SoakHarness:
                 "program_compiles": (
                     cur["program_compiles_cum"]
                     - prev["program_compiles_cum"]
+                ),
+                # corpus analysis (docs/analysis.md): debounced
+                # background recomputes completed this window — the
+                # ingest_corpus_recompute check's evidence
+                "corpus_recomputes": (
+                    cur["corpus_recomputes_cum"]
+                    - prev["corpus_recomputes_cum"]
                 ),
             })
             prev = cur

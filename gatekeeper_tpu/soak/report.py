@@ -144,6 +144,11 @@ def aggregate_phases(windows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "program_compiles": sum(
                 w.get("program_compiles", 0) or 0 for w in ws
             ),
+            # corpus static analysis: debounced background recomputes
+            # completed in the phase (ingest_corpus_recompute evidence)
+            "corpus_recomputes": sum(
+                w.get("corpus_recomputes", 0) or 0 for w in ws
+            ),
         })
     return out
 
@@ -253,6 +258,16 @@ def build_checks(
         checks["ingest_zero_degraded"] = (
             ingest.get("degraded_dispatches", 0) == 0
             and ingest["http_5xx"] == 0
+        )
+        # corpus static analysis (docs/analysis.md §Corpus analysis):
+        # the wave's churn must trigger a corpus recompute — in the
+        # background and DEBOUNCED (a handful of recomputes for a
+        # hundreds-of-templates wave, never one per add) — while the
+        # request path stays untouched (the latency/5xx side of that
+        # claim is pinned by ingest_zero_degraded above)
+        n_rec = ingest.get("corpus_recomputes", 0) or 0
+        checks["ingest_corpus_recompute"] = (
+            0 < n_rec <= 2 * ingest["windows"] + 2
         )
     kill = by_name.get("kill")
     if kill and kill["requests"]:

@@ -736,6 +736,12 @@ class MicroBatcher:
                     "partitions_skipped": list(skipped_parts),
                     "partitions_touched": touched,
                     "rows_total": corpus_rows,
+                    # provably-dead rows the corpus analyzer removed
+                    # from the plan before dispatch ("why didn't this
+                    # constraint fire" — docs/analysis.md)
+                    "rows_excluded_static": len(
+                        getattr(plan, "excluded_static", ()) or ()
+                    ),
                     # the per-request rows pruned dispatch pays:
                     # constraint rows of the partitions this request's
                     # mask actually selects
@@ -982,6 +988,9 @@ class WebhookServer:
         # way /debug/costs is tagged
         attributor=None,
         replica: Optional[str] = None,
+        # analysis.corpus.CorpusPlane: feeds the partition planner its
+        # provably-dead (verdict-safe prunable) constraint keys
+        corpus=None,
     ):
         self.client = client  # warmup() compiles through it
         self.tracer = tracer
@@ -1004,6 +1013,7 @@ class WebhookServer:
                 recorder=recorder,
                 attributor=attributor,
                 replica=replica,
+                corpus=corpus,
             )
         # graceful-drain state: `draining` flips BEFORE the listener
         # closes (readiness consults it), in-flight HTTP requests are

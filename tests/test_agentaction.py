@@ -529,6 +529,13 @@ _GATE_ALLOWED = {
     # bench_webhook.py plays outside the package) — it consumes the
     # target's public schema, it does not bypass the boundary
     "soak/harness.py",
+    # the corpus static pass PROVES facts about the K8s match CR schema
+    # (dead-match proofs P1–P5, subsumption) — like constraint/match.py
+    # it is the semantics, not a consumer routing around the handler;
+    # its GK-C008 witness harness drives a throwaway client through the
+    # target's public AdmissionRequest API exactly as the soak harness
+    # does
+    "analysis/corpus.py",
 }
 # modules allowed to import the match-semantics engine directly (the
 # boundary, the engine's own internals, and public re-exports)

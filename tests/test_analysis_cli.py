@@ -286,3 +286,121 @@ def test_reference_library_ports_pinned_vectorized():
         "K8sPSPPrivileged",
     ):
         assert recorded.get(kind) == "VECTORIZED", kind
+
+
+# -- corpus mode (docs/analysis.md §Corpus analysis) --------------------------
+
+CORPUS_BASELINE = os.path.join(DEPLOY, "corpus-baseline.json")
+
+DEAD_CONSTRAINT = """apiVersion: templates.gatekeeper.sh/v1beta1
+kind: ConstraintTemplate
+metadata:
+  name: corpusclitest
+spec:
+  crd:
+    spec:
+      names:
+        kind: CorpusCliTest
+  targets:
+    - target: admission.k8s.gatekeeper.sh
+      rego: |
+        package corpusclitest
+        violation[{"msg": msg}] {
+          input.review.object.spec.hostNetwork
+          msg := "no hostNetwork"
+        }
+---
+apiVersion: constraints.gatekeeper.sh/v1beta1
+kind: CorpusCliTest
+metadata:
+  name: dead-row
+spec:
+  match:
+    scope: Namespaced
+    namespaces: ["ns-a"]
+    excludedNamespaces: ["ns-a"]
+"""
+
+
+def test_corpus_shipped_policies_hold_the_baseline(capsys):
+    """The CI gate: the shipped deploy/ corpus must match its recorded
+    cross-plane manifest (all four doc planes analyzed together)."""
+    rc = run(["corpus", DEPLOY, "--baseline", CORPUS_BASELINE])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "OK:" in out
+
+
+def test_corpus_baseline_manifest_is_current():
+    from gatekeeper_tpu.analysis.cli import (
+        collect_constraints,
+        collect_mutators,
+        collect_providers,
+        collect_templates,
+    )
+    from gatekeeper_tpu.analysis.corpus import corpus_from_docs
+
+    with open(CORPUS_BASELINE) as f:
+        recorded = json.load(f)["corpus"]
+    report = corpus_from_docs(
+        [(s, o) for s, o in collect_templates([DEPLOY])
+         if isinstance(o, dict)],
+        collect_constraints([DEPLOY]),
+        collect_mutators([DEPLOY]),
+        collect_providers([DEPLOY]),
+    )
+    assert {l.id: sorted(l.codes) for l in report.lints} == recorded
+
+
+def test_corpus_dead_constraint_flagged_then_baselined(tmp_path, capsys):
+    (tmp_path / "corpus.yaml").write_text(DEAD_CONSTRAINT)
+    rc = run(["corpus", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "GK-C006" in out
+    pinned = tmp_path / "pinned.json"
+    rc = run(["corpus", str(tmp_path), "--write-baseline", str(pinned)])
+    assert rc == 1  # flagged until the baseline accepts it
+    rc = run(["corpus", str(tmp_path), "--baseline", str(pinned)])
+    assert rc == 0
+    capsys.readouterr()
+
+
+def test_corpus_json_output(tmp_path, capsys):
+    (tmp_path / "corpus.yaml").write_text(DEAD_CONSTRAINT)
+    rc = run(["corpus", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    codes = {s["id"]: s["codes"] for s in payload["corpus"]}
+    assert codes["constraint:CorpusCliTest/dead-row"] == ["GK-C006"]
+    assert codes["template:CorpusCliTest"] == []
+
+
+def test_corpus_none_found(tmp_path):
+    assert run(["corpus", str(tmp_path)]) == 2
+
+
+# -- all mode: the one-shot gate ----------------------------------------------
+
+
+def test_all_gate_over_shipped_policies(capsys):
+    """`analysis all deploy/policies` runs every plane against its
+    conventional baseline and rolls the exit codes into one gate."""
+    rc = run(["all", DEPLOY])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    for plane in ("templates", "mutators", "providers", "corpus"):
+        assert f"== {plane} ==" in out
+    assert "== gate ==" in out
+
+
+def test_all_gate_fails_on_any_plane(tmp_path, capsys):
+    (tmp_path / "corpus.yaml").write_text(DEAD_CONSTRAINT)
+    rc = run(["all", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "GK-C006" in out
+
+
+def test_all_gate_empty_dir(tmp_path):
+    assert run(["all", str(tmp_path)]) == 2

@@ -1,0 +1,648 @@
+"""Corpus-wide static analysis: the GK-C0xx golden battery + the
+dead-row static-pruning parity battery (docs/analysis.md §Corpus
+analysis).
+
+What it pins:
+  * one golden per diagnostic code — each seeded defect yields exactly
+    its code (referential integrity GK-C001..C003, parameter
+    type-check GK-C004/C005, dead-match proofs GK-C006, subsumption
+    GK-C007, mutate<->validate fights GK-C008);
+  * soundness of the dead-match prover against the live match oracle —
+    every constraint the prover calls dead yields zero results on a
+    request battery through the real client;
+  * verdict-safe static pruning — merged verdicts through a
+    PartitionDispatcher with the corpus plane attached are
+    byte-identical to both the monolith and the pruning-off dispatcher
+    while `excluded_static` carries the dead rows;
+  * the CorpusPlane serving contract — generation-gated prunable_keys
+    (stale report prunes nothing), debounced background recompute,
+    /readyz snapshot fields, and the analyzer-report re-attach that
+    keeps /readyz verdicts live through warm-swap recompiles.
+
+Runs in tier-1 (numpy-mode TpuDriver; the throwaway fight-pass clients
+use the pure-Python interpreter). Run alone with -m corpus.
+"""
+
+import json
+
+import pytest
+
+from gatekeeper_tpu.analysis.corpus import (
+    CorpusPlane,
+    analyze_corpus,
+    corpus_from_docs,
+    corpus_from_live,
+    match_is_dead,
+    match_subsumes,
+)
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget, TpuDriver
+from gatekeeper_tpu.parallel.partition import PartitionDispatcher
+
+from test_partition import (
+    TARGET,
+    augmented,
+    battery_request,
+    build_battery_client,
+    dispatch_pruned_batch,
+    normalize,
+)
+
+pytestmark = pytest.mark.corpus
+
+
+# -- doc builders (the offline corpus_from_docs entry) ------------------------
+
+V_REGO = """package corpreq
+violation[{"msg": msg}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("missing: %v", [missing])
+}
+"""
+
+HOSTNET_REGO = """package corphostnet
+violation[{"msg": msg}] {
+    input.review.object.spec.hostNetwork
+    msg := "hostNetwork is not allowed"
+}
+"""
+
+EXT_REGO_ERR = """package corpext
+violation[{"msg": msg}] {
+    images := [img | img := input.review.object.spec.containers[_].image]
+    response := external_data({"provider": "PROVIDER", "keys": images})
+    count(response.errors) > 0
+    msg := sprintf("image verification failed: %v", [response.errors])
+}
+"""
+
+
+def ext_rego(provider):
+    return EXT_REGO_ERR.replace("PROVIDER", provider)
+
+LABELS_SCHEMA = {
+    "properties": {
+        "labels": {"type": "array", "items": {"type": "string"}},
+    },
+}
+
+
+def template_doc(kind, rego, params_schema=None):
+    crd_spec = {"names": {"kind": kind}}
+    if params_schema is not None:
+        crd_spec["validation"] = {"openAPIV3Schema": params_schema}
+    return (kind.lower(), {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": crd_spec},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    })
+
+
+def constraint_doc(kind, name, match=None, params=None):
+    spec = {}
+    if match is not None:
+        spec["match"] = match
+    if params is not None:
+        spec["parameters"] = params
+    return (f"{kind.lower()}/{name}", {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": spec,
+    })
+
+
+def provider_doc(name, failure_policy):
+    return (name, {
+        "apiVersion": "externaldata.gatekeeper.sh/v1alpha1",
+        "kind": "Provider",
+        "metadata": {"name": name},
+        "spec": {"url": "http://127.0.0.1:1/v1", "timeout": 1,
+                 "failurePolicy": failure_policy},
+    })
+
+
+def assign_hostnetwork_doc(name="force-hostnet"):
+    return (name, {
+        "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+        "kind": "Assign",
+        "metadata": {"name": name},
+        "spec": {
+            "applyTo": [{"groups": [""], "versions": ["v1"],
+                         "kinds": ["Pod"]}],
+            "match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+            "location": "spec.hostNetwork",
+            "parameters": {"assign": {"value": True}},
+        },
+    })
+
+
+POD_MATCH = {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}
+
+# a provably-dead, prunable match: scope-pinned Namespaced with every
+# listed namespace also excluded, and NO namespaceSelector
+DEAD_MATCH = {
+    "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+    "scope": "Namespaced",
+    "namespaces": ["ns-dead"],
+    "excludedNamespaces": ["ns-dead"],
+}
+
+
+def run_corpus(templates=(), constraints=(), mutators=(), providers=()):
+    return corpus_from_docs(
+        list(templates), list(constraints), list(mutators),
+        list(providers),
+    )
+
+
+def codes_for(report, subject):
+    lint = report.lint_for(subject)
+    return lint.codes
+
+
+# -- the golden battery: one seeded defect per code ---------------------------
+
+
+def test_c001_missing_provider():
+    report = run_corpus(
+        templates=[template_doc("CorpExt", ext_rego("ghost"))],
+    )
+    assert codes_for(report, "template:CorpExt") == ["GK-C001"]
+    assert not report.ok
+
+
+def test_c002_orphan_constraint():
+    report = run_corpus(
+        constraints=[constraint_doc("NoSuchKind", "orphan",
+                                    match=POD_MATCH)],
+    )
+    assert codes_for(report, "constraint:NoSuchKind/orphan") == ["GK-C002"]
+
+
+def test_c003_error_gated_template_behind_fail_open_provider():
+    report = run_corpus(
+        templates=[template_doc("CorpExt", ext_rego("registry"))],
+        providers=[provider_doc("registry", "Ignore")],
+    )
+    assert codes_for(report, "template:CorpExt") == ["GK-C003"]
+    # fail-closed resolves the tension: same template, no diagnostic
+    clean = run_corpus(
+        templates=[template_doc("CorpExt", ext_rego("registry"))],
+        providers=[provider_doc("registry", "Fail")],
+    )
+    assert clean.ok
+
+
+def test_c004_parameter_type_mismatch():
+    report = run_corpus(
+        templates=[template_doc("CorpReq", V_REGO, LABELS_SCHEMA)],
+        constraints=[constraint_doc("CorpReq", "bad", match=POD_MATCH,
+                                    params={"labels": "owner"})],
+    )
+    assert codes_for(report, "constraint:CorpReq/bad") == ["GK-C004"]
+    d = [x for x in report.diagnostics if x.code == "GK-C004"][0]
+    assert d.path == "spec.parameters"  # provenance rides the record
+
+
+def test_c005_unknown_parameter_key():
+    report = run_corpus(
+        templates=[template_doc("CorpReq", V_REGO, LABELS_SCHEMA)],
+        constraints=[constraint_doc("CorpReq", "typo", match=POD_MATCH,
+                                    params={"lables": ["owner"]})],
+    )
+    assert codes_for(report, "constraint:CorpReq/typo") == ["GK-C005"]
+    assert "lables" in report.diagnostics[0].message
+
+
+DEAD_MATCHES = [
+    # P1: kinds present but no satisfiable entry
+    {"kinds": []},
+    {"kinds": [{"apiGroups": [""], "kinds": []}]},
+    # P2: unknown scope token (the matcher compares exact strings)
+    {"scope": "namespaced"},
+    # P3: every listed namespace is also excluded
+    DEAD_MATCH,
+    # P4: malformed labelSelector.matchLabels never matches
+    {"labelSelector": {"matchLabels": "not-a-dict"}},
+    # P5: same-key Exists/DoesNotExist contradiction
+    {"labelSelector": {"matchExpressions": [
+        {"key": "team", "operator": "Exists"},
+        {"key": "team", "operator": "DoesNotExist"},
+    ]}},
+]
+
+
+@pytest.mark.parametrize("match", DEAD_MATCHES)
+def test_c006_dead_match_proofs(match):
+    report = run_corpus(
+        templates=[template_doc("CorpReq", V_REGO, LABELS_SCHEMA)],
+        constraints=[constraint_doc("CorpReq", "dead", match=match,
+                                    params={"labels": ["owner"]})],
+    )
+    assert codes_for(report, "constraint:CorpReq/dead") == ["GK-C006"]
+    assert report.dead_keys == ["CorpReq/dead"]
+
+
+@pytest.mark.parametrize("match", [
+    None,
+    POD_MATCH,
+    {"namespaces": ["prod"]},
+    {"scope": "*"},
+    {"kinds": [{"apiGroups": ["*"], "kinds": ["*"]}]},
+    {"labelSelector": {"matchLabels": {"team": "core"}}},
+    # excluded does not cover the listed namespaces -> satisfiable
+    {"namespaces": ["a", "b"], "excludedNamespaces": ["a"]},
+])
+def test_c006_live_matches_not_flagged(match):
+    report = run_corpus(
+        templates=[template_doc("CorpReq", V_REGO, LABELS_SCHEMA)],
+        constraints=[constraint_doc("CorpReq", "live", match=match,
+                                    params={"labels": ["owner"]})],
+    )
+    assert report.dead_keys == []
+    assert codes_for(report, "constraint:CorpReq/live") == []
+
+
+def test_c006_dead_prover_sound_against_match_oracle():
+    """Every constraint the prover calls dead yields ZERO results on a
+    shape-varied request battery through the REAL client — the proofs
+    are sound against the oracle, not a parallel reimplementation."""
+    from gatekeeper_tpu.constraint.errors import InvalidConstraintError
+
+    cl = Backend(TpuDriver(use_jax=False)).new_client(K8sValidationTarget())
+    _src, tdoc = template_doc("CorpReq", V_REGO, LABELS_SCHEMA)
+    cl.add_template(tdoc)
+    added = 0
+    for i, match in enumerate(DEAD_MATCHES):
+        _src, cdoc = constraint_doc(
+            "CorpReq", f"dead{i}", match=match,
+            params={"labels": ["owner"]},
+        )
+        try:
+            cl.add_constraint(cdoc)
+            added += 1
+        except InvalidConstraintError:
+            # some dead shapes (bad scope enum, malformed selector)
+            # are rejected at admission — the CRD gate beats the
+            # prover to them; the live oracle check covers the rest
+            continue
+    assert added >= 3
+    report = corpus_from_live(cl)
+    assert len(report.dead_keys) == added
+    reviews = augmented(cl, [battery_request(i) for i in range(23)])
+    for res in cl.review_many(reviews):
+        results = (res.by_target[TARGET].results
+                   if TARGET in res.by_target else [])
+        assert results == []
+
+
+def test_c007_narrow_shadowed_by_broad():
+    broad = constraint_doc("CorpReq", "broad",
+                           match={"namespaces": ["a", "b"]},
+                           params={"labels": ["owner"]})
+    narrow = constraint_doc("CorpReq", "narrow",
+                            match={"namespaces": ["a"]},
+                            params={"labels": ["owner"]})
+    report = run_corpus(
+        templates=[template_doc("CorpReq", V_REGO, LABELS_SCHEMA)],
+        constraints=[broad, narrow],
+    )
+    assert codes_for(report, "constraint:CorpReq/narrow") == ["GK-C007"]
+    assert codes_for(report, "constraint:CorpReq/broad") == []
+    assert report.shadowed == {"CorpReq/narrow": "CorpReq/broad"}
+    # shadowed is a WARNING, never a pruning feed: only provably-dead
+    # rows may leave the dispatch plan
+    assert report.prunable_keys == []
+
+
+def test_c007_different_parameters_not_shadowed():
+    a = constraint_doc("CorpReq", "broad",
+                       match={"namespaces": ["a", "b"]},
+                       params={"labels": ["owner"]})
+    b = constraint_doc("CorpReq", "narrow",
+                       match={"namespaces": ["a"]},
+                       params={"labels": ["team"]})
+    report = run_corpus(
+        templates=[template_doc("CorpReq", V_REGO, LABELS_SCHEMA)],
+        constraints=[a, b],
+    )
+    assert report.shadowed == {}
+
+
+def test_c007_identical_matches_flag_only_the_later_name():
+    docs = [
+        constraint_doc("CorpReq", name, match=dict(POD_MATCH),
+                       params={"labels": ["owner"]})
+        for name in ("alpha", "beta")
+    ]
+    report = run_corpus(
+        templates=[template_doc("CorpReq", V_REGO, LABELS_SCHEMA)],
+        constraints=docs,
+    )
+    assert report.shadowed == {"CorpReq/beta": "CorpReq/alpha"}
+    assert codes_for(report, "constraint:CorpReq/alpha") == []
+
+
+def test_c008_admission_fight():
+    report = run_corpus(
+        templates=[template_doc("CorpHostnet", HOSTNET_REGO)],
+        constraints=[constraint_doc("CorpHostnet", "deny-hostnet",
+                                    match=POD_MATCH)],
+        mutators=[assign_hostnetwork_doc()],
+    )
+    assert codes_for(report, "mutator:Assign/force-hostnet") == ["GK-C008"]
+    msg = report.diagnostics[0].message
+    assert "CorpHostnet/deny-hostnet" in msg
+    assert "spec.hostNetwork" in report.diagnostics[0].path
+
+
+def test_c008_no_fight_when_mutator_writes_elsewhere():
+    _name, doc = assign_hostnetwork_doc("label-pods")
+    doc["spec"]["location"] = "metadata.labels.managed"
+    doc["spec"]["parameters"] = {"assign": {"value": "yes"}}
+    report = run_corpus(
+        templates=[template_doc("CorpHostnet", HOSTNET_REGO)],
+        constraints=[constraint_doc("CorpHostnet", "deny-hostnet",
+                                    match=POD_MATCH)],
+        mutators=[("label-pods", doc)],
+    )
+    assert report.ok
+
+
+def test_clean_subjects_still_get_rows():
+    """The baseline manifest pins the WHOLE corpus: clean subjects
+    appear with empty code lists, so adding a subject changes the
+    manifest even before it ever misbehaves."""
+    report = run_corpus(
+        templates=[template_doc("CorpReq", V_REGO, LABELS_SCHEMA)],
+        constraints=[constraint_doc("CorpReq", "ok", match=POD_MATCH,
+                                    params={"labels": ["owner"]})],
+        providers=[provider_doc("registry", "Fail")],
+    )
+    assert report.ok
+    ids = {lint.id for lint in report.lints}
+    assert {"template:CorpReq", "constraint:CorpReq/ok"} <= ids
+    assert report.subjects == 3  # template + constraint + provider
+
+
+# -- subsumption / dead-proof unit edges --------------------------------------
+
+
+def test_match_subsumes_dimensions():
+    assert match_subsumes({}, {"namespaces": ["a"]})  # absent = wildcard
+    assert match_subsumes({"namespaces": ["a", "b"]},
+                          {"namespaces": ["a"]})
+    assert not match_subsumes({"namespaces": ["a"]},
+                              {"namespaces": ["a", "b"]})
+    # A's exclusions must be a subset of B's for A to cover B
+    assert match_subsumes({"excludedNamespaces": ["x"]},
+                          {"excludedNamespaces": ["x", "y"]})
+    assert not match_subsumes({"excludedNamespaces": ["x", "y"]},
+                              {"excludedNamespaces": ["x"]})
+    # selector dimensions only cover by equality
+    sel = {"labelSelector": {"matchLabels": {"t": "1"}}}
+    assert match_subsumes(dict(sel), dict(sel))
+    assert not match_subsumes(
+        sel, {"labelSelector": {"matchLabels": {"t": "2"}}}
+    )
+
+
+def test_match_is_dead_returns_proof_text():
+    dead, proof = match_is_dead(DEAD_MATCH)
+    assert dead and "excluded" in proof.lower()
+    alive, _ = match_is_dead(POD_MATCH)
+    assert not alive
+
+
+# -- verdict-safe static pruning: the parity battery --------------------------
+
+
+def add_dead_constraints(cl, n, with_ns_selector=False):
+    for i in range(n):
+        match = dict(DEAD_MATCH)
+        if with_ns_selector:
+            match["namespaceSelector"] = {"matchLabels": {"team": "x"}}
+        cl.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "PartReq",
+            "metadata": {"name": f"dead{i:02d}"},
+            "spec": {"match": match,
+                     "parameters": {"labels": ["owner"]}},
+        })
+
+
+def test_static_exclusion_parity_battery():
+    """The acceptance gate: with provably-dead rows seeded into the
+    test_partition mix, merged verdicts through the corpus-wired
+    dispatcher are byte-identical to the monolith AND to the pruning-
+    off dispatcher, while the plan's excluded_static carries exactly
+    the dead rows."""
+    from gatekeeper_tpu.webhook.server import MicroBatcher
+
+    cl = build_battery_client(9)
+    add_dead_constraints(cl, 3)
+    plane = CorpusPlane(cl, debounce_s=0.0)
+    plane.refresh()
+
+    requests = [battery_request(i) for i in range(23)]
+    reviews = augmented(cl, requests)
+    mono = cl.review_many(reviews)  # the monolith sees the dead rows
+
+    disp_off = PartitionDispatcher(cl, TARGET, k=4)
+    disp_on = PartitionDispatcher(cl, TARGET, k=4, corpus=plane)
+    batcher_off = MicroBatcher(cl, TARGET, partitioner=disp_off)
+    batcher_on = MicroBatcher(cl, TARGET, partitioner=disp_on)
+    try:
+        res_off = dispatch_pruned_batch(batcher_off, requests)
+        res_on = dispatch_pruned_batch(batcher_on, requests)
+
+        plan_on = disp_on.plan()
+        assert sorted(plan_on.excluded_static) == [
+            "PartReq/dead00", "PartReq/dead01", "PartReq/dead02",
+        ]
+        assert disp_off.plan().excluded_static == ()
+        # the excluded rows really left the plan
+        on_keys = [k for p in plan_on.partitions for k in p.keys]
+        assert not any(k.startswith("PartReq/dead") for k in on_keys)
+
+        some = False
+        for i in range(len(requests)):
+            expect = (
+                mono[i].by_target[TARGET].results
+                if TARGET in mono[i].by_target else []
+            )
+            assert json.dumps(normalize(res_on[i])) == json.dumps(
+                normalize(res_off[i])
+            ), f"request {i}"
+            assert normalize(res_on[i]) == normalize(expect), f"request {i}"
+            some = some or bool(expect)
+        assert some  # never vacuous
+    finally:
+        batcher_off.stop()
+        batcher_on.stop()
+        disp_off.close()
+        disp_on.close()
+
+
+def test_dead_with_ns_selector_not_pruned():
+    """A dead constraint carrying a namespaceSelector still emits
+    autoreject verdicts on uncached namespaces — it is flagged dead
+    (GK-C006) but NEVER statically excluded."""
+    cl = build_battery_client(3)
+    add_dead_constraints(cl, 1, with_ns_selector=True)
+    plane = CorpusPlane(cl, debounce_s=0.0)
+    report = plane.refresh()
+    assert "PartReq/dead00" in report.dead_keys
+    assert report.prunable_keys == []
+    disp = PartitionDispatcher(cl, TARGET, k=2, corpus=plane)
+    try:
+        assert disp.plan().excluded_static == ()
+    finally:
+        disp.close()
+
+
+def test_stale_corpus_report_prunes_nothing():
+    """Churn after the report was computed: prunable_keys answers
+    empty until the recompute catches up — missing a pruning window is
+    safe, pruning at the wrong generation is not."""
+    cl = build_battery_client(3)
+    add_dead_constraints(cl, 2)
+    plane = CorpusPlane(cl, debounce_s=3600.0)  # debounce blocks bg
+    plane.refresh()
+    gen = cl._driver.constraint_generation()
+    assert plane.prunable_keys(TARGET, gen) == frozenset(
+        {"PartReq/dead00", "PartReq/dead01"}
+    )
+    cl.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "PartReq",
+        "metadata": {"name": "fresh"},
+        "spec": {"match": dict(POD_MATCH),
+                 "parameters": {"labels": ["owner"]}},
+    })
+    new_gen = cl._driver.constraint_generation()
+    assert new_gen != gen
+    assert plane.prunable_keys(TARGET, new_gen) == frozenset()
+    disp = PartitionDispatcher(cl, TARGET, k=2, corpus=plane)
+    try:
+        assert disp.plan().excluded_static == ()  # stale -> no pruning
+        plane.refresh()
+        assert len(disp.plan().excluded_static) == 2  # caught up
+    finally:
+        disp.close()
+
+
+def test_plan_table_flags_excluded_and_shadowed():
+    cl = build_battery_client(6)
+    add_dead_constraints(cl, 1)
+    # an identical-match pair: the later name is shadowed
+    for name in ("twin-a", "twin-b"):
+        cl.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "PartBlob",
+            "metadata": {"name": name},
+            "spec": {"match": dict(POD_MATCH)},
+        })
+    plane = CorpusPlane(cl, debounce_s=0.0)
+    plane.refresh()
+    disp = PartitionDispatcher(cl, TARGET, k=3, corpus=plane)
+    try:
+        table = disp.plan_table()
+        assert table["excluded_static"] == ["PartReq/dead00"]
+        shadowed = {}
+        for row in table["partitions"]:
+            shadowed.update(row.get("shadowed") or {})
+        # the twin pair surfaces (the battery's own identical-match
+        # groups flag too — the table shows every shadowed row)
+        assert "PartBlob/twin-b" in shadowed
+        assert shadowed["PartBlob/twin-b"].startswith("PartBlob/")
+    finally:
+        disp.close()
+
+
+# -- CorpusPlane serving contract ---------------------------------------------
+
+
+def test_plane_debounce_and_generation_tracking():
+    clock = [0.0]
+    cl = build_battery_client(2)
+    plane = CorpusPlane(cl, debounce_s=5.0, clock=lambda: clock[0])
+    plane.refresh()
+    assert plane.recomputes == 1
+    # unchanged generation: no recompute, debounced or not
+    assert plane.maybe_recompute() is False
+    cl.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "PartReq",
+        "metadata": {"name": "churned"},
+        "spec": {"match": dict(POD_MATCH),
+                 "parameters": {"labels": ["owner"]}},
+    })
+    # generation moved but the debounce window is open
+    assert plane.maybe_recompute() is False
+    clock[0] = 10.0
+    assert plane.maybe_recompute() is True
+    plane._pending.join(timeout=30)
+    assert plane.recomputes == 2
+    snap = plane.snapshot()
+    assert snap["computed"] and not snap["stale"]
+    assert snap["recomputes"] == 2
+    assert {"ok", "subjects", "counts", "dead", "prunable",
+            "shadowed"} <= set(snap)
+
+
+def test_plane_exports_gauges_for_every_code():
+    from gatekeeper_tpu.metrics import MetricsRegistry
+
+    cl = build_battery_client(2)
+    add_dead_constraints(cl, 1)
+    metrics = MetricsRegistry()
+    plane = CorpusPlane(cl, metrics=metrics, debounce_s=0.0)
+    plane.refresh()
+    gauges = metrics.snapshot()["gauges"]
+    rows = {k: v for k, v in gauges.items()
+            if k.startswith("corpus_diagnostics_total")}
+    assert len(rows) == 8  # every GK-C0xx code, zeros included
+    assert sum(
+        v for k, v in rows.items() if 'code="GK-C006"' in k
+    ) == 1
+
+
+# -- warm-swap recompile keeps analyzer verdicts live (satellite fix) ---------
+
+
+def test_analyzer_report_survives_recompile_churn():
+    """put_modules drops compiled programs AND the cached analysis;
+    add_template must hand the admission-time report straight back so
+    /readyz verdicts and fallback codes never blink out during
+    warm-swap recompiles."""
+    cl = build_battery_client(0)
+    driver = cl._driver
+    assert driver._analysis.get((TARGET, "PartReq")) is not None
+    assert driver._analysis.get((TARGET, "PartDeep")) is not None
+    # INTERPRETER template: the fallback code is re-derived too
+    assert (TARGET, "PartDeep") in driver._fallback_codes
+    # re-add churn (the warm-swap recompile path): still attached
+    cl.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "partreq"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "PartReq"}}},
+            "targets": [{
+                "target": TARGET,
+                "rego": V_REGO.replace("corpreq", "partreq"),
+            }],
+        },
+    })
+    rep = driver._analysis.get((TARGET, "PartReq"))
+    assert rep is not None and rep.verdict == "VECTORIZED"
+    reports = cl.template_reports()  # keyed by template name
+    assert reports["partreq"].verdict == "VECTORIZED"
