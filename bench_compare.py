@@ -60,6 +60,10 @@ WATCHED: Dict[str, int] = {
     # cross-plane findings in the bench corpus
     "rows_excluded_static": -1,
     "corpus_diagnostics": +1,
+    # IR static analysis (ISSUE 16): fewer dead token slots dropped by
+    # the feature-liveness mask = the IR pass stopped proving columns
+    # dead (host-encode cost regression)
+    "columns_skipped_static": -1,
 }
 
 # context keys that make a row's path stable across runs (rungs and
@@ -105,9 +109,74 @@ def flatten_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
     return out
 
 
+def _balanced_objects(
+    text: str, anchor: str = '{"constraints":'
+) -> List[Dict[str, Any]]:
+    """Complete JSON objects recovered from a truncated capture tail.
+
+    BENCH_r0x captures keep only the LAST bytes of a run's stdout, so
+    the enclosing doc is cut mid-object and `json.loads` fails — but
+    every ladder rung row inside it is still a complete `{"constraints":
+    N, ...}` object. Brace-scanning from each anchor recovers them (rung
+    rows carry no braces inside strings), which is what lets the
+    trajectory gate judge r05-era tails against structured artifacts."""
+    rows: List[Dict[str, Any]] = []
+    i = text.find(anchor)
+    while i != -1:
+        depth = 0
+        for j in range(i, len(text)):
+            ch = text[j]
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    try:
+                        obj = json.loads(text[i : j + 1])
+                        if isinstance(obj, dict):
+                            rows.append(obj)
+                    except ValueError:
+                        pass
+                    break
+        i = text.find(anchor, i + 1)
+    return rows
+
+
+def _recover_capture(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """A comparable doc out of a BENCH_r0x capture ({n, cmd, rc, tail,
+    parsed}): the parsed artifact when the capture got one, else
+    whatever survives in the tail — a SUMMARY line, a parseable JSON
+    line, or complete ladder rung objects fished out of the truncated
+    stream."""
+    from gatekeeper_tpu.summary import find_summary
+
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        return parsed
+    tail = doc.get("tail")
+    if isinstance(tail, str) and tail:
+        rec = find_summary(tail)
+        if rec is not None:
+            return rec
+        for line in tail.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    obj = json.loads(line)
+                    if isinstance(obj, dict):
+                        return obj
+                except ValueError:
+                    continue
+        rows = _balanced_objects(tail)
+        if rows:
+            return {"webhook_constraint_ladder": rows}
+    return doc
+
+
 def load_run(path: str) -> Dict[str, Any]:
-    """A bench doc from a file: JSON artifact, or a run log whose last
-    SUMMARY line becomes the doc (the truncation-survivor path)."""
+    """A bench doc from a file: JSON artifact (BENCH_r0x captures are
+    unwrapped/recovered), or a run log whose last SUMMARY line becomes
+    the doc (the truncation-survivor path)."""
     from gatekeeper_tpu.summary import find_summary
 
     with open(path) as f:
@@ -115,6 +184,8 @@ def load_run(path: str) -> Dict[str, Any]:
     try:
         doc = json.loads(text)
         if isinstance(doc, dict):
+            if "tail" in doc and "parsed" in doc:
+                return _recover_capture(doc)
             return doc
     except ValueError:
         pass

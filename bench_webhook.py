@@ -38,6 +38,22 @@ WEBHOOK_MIX = [
 ]
 
 
+# repo-local fallback mix for containers without the reference
+# checkout: the shipped reference-library bundle, same constraint
+# shape and the same 100%-violating stress coverage (privileged +
+# repos + labels all trip on make_request's violating pod)
+LOCAL_BUNDLE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "deploy", "policies", "reference-library.yaml",
+)
+LOCAL_MIX = [
+    ("K8sPSPPrivileged", None),
+    ("K8sAllowedRepos", {"repos": ["nginx", "gcr.io/prod"]}),
+    ("K8sRequiredLabels", {"labels": [{"key": "app"}]}),
+    ("K8sBlockNodePort", None),
+]
+
+
 def _load_template(path):
     import yaml
 
@@ -45,14 +61,35 @@ def _load_template(path):
         return yaml.safe_load(f)
 
 
+def _webhook_mix():
+    """[(template_doc, kind, params)] — the reference checkout's mix
+    when present, else the shipped reference-library bundle."""
+    if os.path.isdir(LIB):
+        return [
+            (_load_template(f"{tdir}/template.yaml"), kind, params)
+            for tdir, kind, params in WEBHOOK_MIX
+        ]
+    import yaml
+
+    with open(LOCAL_BUNDLE) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    by_kind = {d["spec"]["crd"]["spec"]["names"]["kind"]: d for d in docs}
+    return [
+        (by_kind[kind], kind, params)
+        for kind, params in LOCAL_MIX
+        if kind in by_kind
+    ]
+
+
 def build_webhook_client(driver, n_constraints):
     from gatekeeper_tpu.constraint import Backend, K8sValidationTarget
 
+    mix = _webhook_mix()
     client = Backend(driver).new_client(K8sValidationTarget())
-    for tdir, _kind, _params in WEBHOOK_MIX:
-        client.add_template(_load_template(f"{tdir}/template.yaml"))
+    for doc, _kind, _params in mix:
+        client.add_template(doc)
     for i in range(n_constraints):
-        tdir, kind, params = WEBHOOK_MIX[i % len(WEBHOOK_MIX)]
+        _doc, kind, params = mix[i % len(mix)]
         # namespace affinity aligned with make_request's ns{i % 11}: a
         # constraint governs one namespace, so the locality planner can
         # co-locate each namespace's constraints and mask-gated pruned
@@ -1766,6 +1803,13 @@ def run_constraint_ladder(err=sys.stderr, rungs=LADDER, budget_s=None,
                 }
                 rung["fused"]["partitions_touched"] = (
                     ladder_disp.touched_stats()
+                )
+                # IR feature-liveness headline: dead token slots the
+                # encoder dropped before padding across this rung's
+                # batches (0 = masking off or nothing provable)
+                _drv = getattr(client, "_driver", None)
+                rung["fused"]["columns_skipped_static"] = int(
+                    getattr(_drv, "columns_skipped_static", 0) or 0
                 )
                 if capture is not None:
                     _pex, fut = capture

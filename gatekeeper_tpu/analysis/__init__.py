@@ -14,9 +14,26 @@ The analyzer is consulted by `constraint/client.py` at template
 admission (INVALID templates are rejected with the diagnostics) and by
 `constraint/tpudriver.py` ahead of compilation (INTERPRETER templates
 route without a try/except around `compile_program`).
+
+A second, program-level plane lives in `ir.py` (PR 16): abstract
+interpretation and feature liveness over the compiled program IR, with
+stable `GK-P01x` codes, the `python -m gatekeeper_tpu.analysis ir`
+CLI mode, and the driver-side liveness masking consumed by
+`constraint/tpudriver.py`.
 """
 
 from .analyzer import Analyzer, analyze_modules, analyze_template  # noqa: F401
+from .ir import (  # noqa: F401
+    Certificate,
+    IR_CODES,
+    IrDiagnostic,
+    IrLint,
+    IrReport,
+    corpus_liveness,
+    ir_from_docs,
+    ir_from_programs,
+    program_liveness,
+)
 from .report import (  # noqa: F401
     CODE_MISMATCH,
     CODES,

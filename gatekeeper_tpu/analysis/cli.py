@@ -8,6 +8,8 @@
         [--json] [--baseline FILE] [--write-baseline FILE]
     python -m gatekeeper_tpu.analysis corpus deploy/ [more paths...]
         [--json] [--baseline FILE] [--write-baseline FILE]
+    python -m gatekeeper_tpu.analysis ir deploy/ [more paths...]
+        [--json] [--baseline FILE] [--write-baseline FILE]
     python -m gatekeeper_tpu.analysis all [deploy/policies]
 
 Default mode scans the given files/directories for ConstraintTemplate
@@ -35,12 +37,22 @@ providers, orphan constraints, parameter/schema mismatches, dead and
 shadowed matches, mutate↔validate admission fights. Baseline
 manifest: {"corpus": {subject: [codes]}}.
 
+`ir` mode compiles every template and constraint into the fused
+program IR and runs the program-level static analysis (GK-P01x,
+docs/analysis.md §IR analysis): feature liveness (which token columns
+any compiled program can observe), abstract interpretation over the
+burned-in constraint parameters (always/never-firing rules, dead
+parameters, no-op checks, unreachable branches), and the fused-path
+taxonomy for anything routed to the interpreter. Baseline manifest:
+{"ir": {subject: [codes]}}.
+
 `all` mode is the one-shot repo gate: templates + mutators +
-providers + corpus over one directory (default `deploy/policies`),
-each compared against its conventional checked-in baseline when
-present (`analysis-baseline.json`, `mutators-baseline.json`,
-`providers-baseline.json`, `corpus-baseline.json` in that directory),
-folded into a single exit code.
+providers + corpus + ir over one directory (default
+`deploy/policies`), each compared against its conventional checked-in
+baseline when present (`analysis-baseline.json`,
+`mutators-baseline.json`, `providers-baseline.json`,
+`corpus-baseline.json`, `ir-baseline.json` in that directory), folded
+into a single exit code.
 
 Shared contract across all subcommands (normalized in PR 15 — they
 had grown ad hoc per PR):
@@ -405,6 +417,54 @@ def run_corpus(argv: List[str]) -> int:
     return rc
 
 
+def run_ir(argv: List[str]) -> int:
+    """`ir` mode: compile every template + constraint found under the
+    given paths into the fused program IR and run the program-level
+    static analysis (GK-P01x, docs/analysis.md §IR analysis): abstract
+    interpretation over burned-in parameters, feature liveness, and
+    the fused-path taxonomy."""
+    from .ir import ir_from_docs
+
+    ap = argparse.ArgumentParser(
+        prog="python -m gatekeeper_tpu.analysis ir",
+        description=(
+            "Program-IR static analysis (liveness + abstract "
+            "interpretation over compiled templates/constraints)"
+        ),
+    )
+    ap.add_argument("paths", nargs="+", help="policy YAML files or dirs")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--baseline", help="code manifest to compare against")
+    ap.add_argument(
+        "--write-baseline", help="write the current codes to FILE"
+    )
+    args = ap.parse_args(argv)
+
+    template_docs = [
+        doc
+        for _src, doc in collect_templates(args.paths)
+        if isinstance(doc, dict)  # bare .rego has no IR identity
+    ]
+    constraint_docs = [doc for _src, doc in collect_constraints(args.paths)]
+    if not template_docs:
+        print("no ConstraintTemplates found", file=sys.stderr)
+        return 2
+
+    report = ir_from_docs(template_docs + constraint_docs)
+    rc = _run_code_lints(args, "ir", "subject", report.lints)
+    if not args.json:
+        live = report.liveness or {}
+        print(
+            f"ir: programs={live.get('programs', 0)} "
+            f"maskable={live.get('maskable', 0)} "
+            f"keep_all={live.get('keep_all')} "
+            f"live_patterns={live.get('live_patterns')}"
+            f"/{live.get('patterns_total')} "
+            f"certificates={len(report.certificates)}"
+        )
+    return rc
+
+
 def run_all(argv: List[str]) -> int:
     """`all` mode: the one-shot repo gate. Runs templates + mutators +
     providers + corpus over one directory against their conventional
@@ -430,6 +490,7 @@ def run_all(argv: List[str]) -> int:
         ("mutators", run_mutators, "mutators-baseline.json"),
         ("providers", run_providers, "providers-baseline.json"),
         ("corpus", run_corpus, "corpus-baseline.json"),
+        ("ir", run_ir, "ir-baseline.json"),
     ]
     results: Dict[str, int] = {}
     for name, fn, baseline_name in planes:
@@ -463,6 +524,8 @@ def run(argv: List[str]) -> int:
         return run_providers(argv[1:])
     if argv and argv[0] == "corpus":
         return run_corpus(argv[1:])
+    if argv and argv[0] == "ir":
+        return run_ir(argv[1:])
     if argv and argv[0] == "all":
         return run_all(argv[1:])
     ap = argparse.ArgumentParser(

@@ -1,0 +1,289 @@
+"""AST lint for jax.jit call sites (GK-J0xx).
+
+jax.jit's static-argument contract fails at TRACE time, long after the
+code that broke it was merged: `static_argnames` naming a parameter the
+wrapped function no longer has is silently ignored (the argument is
+traced, every distinct value recompiles), and a static parameter whose
+default is an unhashable container raises `ValueError: unhashable
+static arguments` only on the first call that uses the default. Both
+are statically decidable from the AST, so this lint runs as a tier-1
+test over the whole package (tests/test_jit_lint.py) instead of
+waiting for a TPU to notice.
+
+Covered shapes:
+
+  * `@partial(jax.jit, static_argnames=..., static_argnums=...)`
+    decorating a `def` (engine/matchkernel.py idiom);
+  * `jax.jit(fn, static_argnames=..., ...)` where `fn` resolves to a
+    `def` in the same file (parallel/sharding.py idiom).
+
+Codes:
+
+  GK-J001  static_argnames names a parameter absent from the wrapped
+           function's signature (drifted argnames)
+  GK-J002  static_argnums is out of range for the wrapped function's
+           positional parameters
+  GK-J003  a static parameter's default value is an unhashable literal
+           (list/dict/set): the first defaulted call raises
+
+Names/nums that are not literal constants (computed at runtime) are
+skipped — the lint only reports what it can prove.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["JitFinding", "lint_file", "lint_source", "lint_paths"]
+
+JIT_CODES: Dict[str, str] = {
+    "GK-J001": "static_argnames drifted from the function signature",
+    "GK-J002": "static_argnums out of positional range",
+    "GK-J003": "static parameter defaults to an unhashable literal",
+}
+
+
+@dataclass(frozen=True)
+class JitFinding:
+    file: str
+    line: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.code}] {self.message}"
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """`jax.jit` or a bare `jit` (from jax import jit)."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return isinstance(node.value, ast.Name) and node.value.id == "jax"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _const_str_seq(node: ast.AST) -> Optional[List[str]]:
+    """A literal str or tuple/list of literal strs, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in node.elts:
+            if not (
+                isinstance(el, ast.Constant)
+                and isinstance(el.value, str)
+            ):
+                return None
+            out.append(el.value)
+        return out
+    return None
+
+
+def _const_int_seq(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for el in node.elts:
+            if not (
+                isinstance(el, ast.Constant)
+                and isinstance(el.value, int)
+                and not isinstance(el.value, bool)
+            ):
+                return None
+            out.append(el.value)
+        return out
+    return None
+
+
+def _fn_params(fn: ast.AST) -> Optional[Tuple[List[str], bool, Dict[str, ast.AST]]]:
+    """-> (positional param names, has *args, {param: default-node}) for
+    a def/lambda, None for anything else."""
+    if not isinstance(
+        fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    ):
+        return None
+    a = fn.args
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    names = pos + [p.arg for p in a.kwonlyargs]
+    defaults: Dict[str, ast.AST] = {}
+    pos_defaults = a.defaults
+    for param, d in zip(pos[len(pos) - len(pos_defaults):], pos_defaults):
+        defaults[param] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            defaults[p.arg] = d
+    return names, a.vararg is not None, defaults
+
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.DictComp, ast.ListComp,
+               ast.SetComp)
+
+
+def _check_site(
+    file: str,
+    call: ast.Call,
+    fn: Optional[ast.AST],
+    out: List[JitFinding],
+) -> None:
+    """One jit(...) call (or partial(jax.jit, ...) decorator) against
+    the wrapped function's AST, when it could be resolved."""
+    argnames: Optional[List[str]] = None
+    argnums: Optional[List[int]] = None
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            argnames = _const_str_seq(kw.value)
+        elif kw.arg == "static_argnums":
+            argnums = _const_int_seq(kw.value)
+    if fn is None or (argnames is None and argnums is None):
+        return
+    sig = _fn_params(fn)
+    if sig is None:
+        return
+    names, has_vararg, defaults = sig
+    static: List[str] = []
+    for n in argnames or ():
+        if n not in names:
+            out.append(
+                JitFinding(
+                    file,
+                    call.lineno,
+                    "GK-J001",
+                    f"static_argnames={n!r} is not a parameter of the "
+                    "wrapped function (drifted after a signature "
+                    "change?): jax silently traces it instead",
+                )
+            )
+        else:
+            static.append(n)
+    n_pos = len(names)
+    for i in argnums or ():
+        idx = i if i >= 0 else n_pos + i
+        if not has_vararg and not (0 <= idx < n_pos):
+            out.append(
+                JitFinding(
+                    file,
+                    call.lineno,
+                    "GK-J002",
+                    f"static_argnums={i} is out of range for a "
+                    f"{n_pos}-parameter function",
+                )
+            )
+        elif 0 <= idx < n_pos:
+            static.append(names[idx])
+    for n in static:
+        d = defaults.get(n)
+        if d is not None and isinstance(d, _UNHASHABLE):
+            out.append(
+                JitFinding(
+                    file,
+                    call.lineno,
+                    "GK-J003",
+                    f"static parameter {n!r} defaults to an unhashable "
+                    f"{type(d).__name__.lower()} literal: the first "
+                    "defaulted call raises at trace time",
+                )
+            )
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, file: str):
+        self.file = file
+        self.findings: List[JitFinding] = []
+        # name -> def node, per enclosing-scope stack (closest wins)
+        self._scopes: List[Dict[str, ast.AST]] = [{}]
+
+    def _resolve(self, name: str) -> Optional[ast.AST]:
+        for scope in reversed(self._scopes):
+            if name in scope:
+                return scope[name]
+        return None
+
+    def _visit_fn(self, node) -> None:
+        self._scopes[-1][node.name] = node
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call) and self._is_partial_jit(dec):
+                _check_site(self.file, dec, node, self.findings)
+            elif isinstance(dec, ast.Call) and _is_jax_jit(dec.func):
+                _check_site(self.file, dec, node, self.findings)
+        self._scopes.append({})
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    @staticmethod
+    def _is_partial_jit(call: ast.Call) -> bool:
+        f = call.func
+        is_partial = (
+            isinstance(f, ast.Name) and f.id == "partial"
+        ) or (
+            isinstance(f, ast.Attribute) and f.attr == "partial"
+        )
+        return bool(
+            is_partial and call.args and _is_jax_jit(call.args[0])
+        )
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scopes.append({})
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_jax_jit(node.func) and node.args:
+            target = node.args[0]
+            fn: Optional[ast.AST] = None
+            if isinstance(target, ast.Lambda):
+                fn = target
+            elif isinstance(target, ast.Name):
+                fn = self._resolve(target.id)
+            _check_site(self.file, node, fn, self.findings)
+        self.generic_visit(node)
+
+
+def lint_source(source: str, file: str = "<string>") -> List[JitFinding]:
+    try:
+        tree = ast.parse(source, filename=file)
+    except SyntaxError as e:
+        return [
+            JitFinding(file, e.lineno or 0, "GK-J000",
+                       f"file does not parse: {e.msg}")
+        ]
+    v = _Visitor(file)
+    v.visit(tree)
+    return v.findings
+
+
+def lint_file(path: str) -> List[JitFinding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths: Iterable[str]) -> List[JitFinding]:
+    out: List[JitFinding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        out.extend(lint_file(os.path.join(root, fn)))
+        elif p.endswith(".py"):
+            out.extend(lint_file(p))
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import sys
+
+    paths = list(argv if argv is not None else sys.argv[1:]) or ["."]
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f.render())
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,7 +41,7 @@ import hashlib
 import json
 import re
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -53,7 +53,7 @@ from ..analysis import (
 )
 from ..engine.matchkernel import matchspec_to_np
 from ..faults import device_point, fire
-from ..engine.patterns import PatternRegistry
+from ..engine.patterns import PatternRegistry, _match
 from ..engine.programs import Program, ProgramEvaluator, compile_program
 from ..engine.symbolic import CompilerEnv, CompileUnsupported
 from ..engine.tables import StrTables
@@ -61,6 +61,7 @@ from ..flatten.encoder import (
     _bucket,
     batch_review_features,
     encode_token_table,
+    mask_token_table,
     unesc_seg,
 )
 from ..flatten.vocab import Vocab
@@ -186,6 +187,9 @@ class _Corpus:
     # strings + their pattern/table rows, never interned globally
     vocab: Any = None  # OverlayVocab for ephemeral corpora, else None
     v_base: int = 0
+    # provably-dead token slots dropped by the IR feature-liveness mask
+    # before padding (analysis/ir.py); 0 when encoded keep-all
+    skipped_static: int = 0
     ov_member: Optional[np.ndarray] = None  # [B_pad, P] bool
     ov_capture: Optional[np.ndarray] = None  # [B_pad, P] int32
     ov_tabs: Optional[Dict[str, np.ndarray]] = None  # name -> [B_pad]
@@ -214,6 +218,11 @@ class _ConstraintSet:
     # (docs/compile.md): a constraint-generation bump whose signature is
     # unchanged carries the staged policy forward instead of restaging
     signature: Optional[str] = None
+    # IR feature-liveness over this set's programs (analysis/ir.py),
+    # computed once per set: False = not yet computed, None = keep-all
+    # (some program failed the pad-equivalence proof), frozenset = live
+    # pattern indices
+    live_pids: Any = False
 
 
 class TpuDriver(RegoDriver):
@@ -328,6 +337,20 @@ class TpuDriver(RegoDriver):
         self.program_compiles = 0  # compile_program invocations
         self.subset_swaps = 0  # shadow sets atomically swapped live
         self.subset_carryforwards = 0  # gen bumps served by signature
+        # IR static-analysis plane (analysis/ir.py): ephemeral review
+        # batches encode under the constraint set's feature-liveness
+        # mask, dropping token columns no compiled program can read
+        # before padding. Disabled via env for parity audits; the
+        # persistent audit corpus always encodes keep-all (it is cached
+        # per DATA generation and must survive constraint churn).
+        self.liveness_enabled = (
+            _os.environ.get("GATEKEEPER_TPU_NO_STATIC_LIVENESS", "") == ""
+        )
+        self.columns_skipped_static = 0  # cumulative dead slots dropped
+        self.liveness_batches = 0  # batches encoded under a live mask
+        # target -> (constraint_gen, IrReport): lazily computed, pre-
+        # populated across warm swaps by attach_ir_report
+        self._ir_reports: Dict[str, Tuple[int, Any]] = {}
 
     # -- module/data bookkeeping (cache invalidation) ------------------------
 
@@ -356,6 +379,7 @@ class TpuDriver(RegoDriver):
         self._analysis.pop((target, kind), None)
         self._fallback_codes.pop((target, kind), None)
         self._ir_hashes.pop((target, kind), None)
+        self._ir_reports.pop(target, None)
         for cache in (self._prune_oracles, self._prune_indexes):
             for key in [
                 k for k in cache if k[0] == target and k[1] == kind
@@ -907,15 +931,37 @@ class TpuDriver(RegoDriver):
         reviews: List[Any],
         ns_cache: Dict[str, Any],
         vocab: Any = None,
-    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any], int, np.ndarray]:
+        keep_fn: Optional[Callable[[int], bool]] = None,
+    ) -> Tuple[
+        Dict[str, np.ndarray], Dict[str, Any], int, np.ndarray, int
+    ]:
         """`vocab` overrides the intern target — ephemeral review batches
         pass an OverlayVocab so batch churn never grows the base.
         Review-feature extraction is the target handler's (the K8s and
-        agent targets share the engine encoding via their IR reviews)."""
+        agent targets share the engine encoding via their IR reviews).
+
+        `keep_fn` (spath vocab id -> bool) is the IR feature-liveness
+        mask: provably-dead token columns are dropped and survivors
+        compacted BEFORE the L/G bucketing below, so padding and the
+        one-hot group contraction shrink with the live set. Overflow is
+        decided by the unfiltered encode (a truncated row already lost
+        arbitrary live tokens and must keep routing to the
+        interpreter); everything downstream sees only the filtered
+        table, so fewer G_CAP clips after filtering is strictly more
+        fused coverage, never a verdict change."""
         if vocab is None:
             vocab = self.vocab
         handler = self._handler(target)
         table = encode_token_table(reviews, vocab)
+        skipped = 0
+        if keep_fn is not None:
+            table, skipped = mask_token_table(table, keep_fn)
+            if skipped:
+                self.columns_skipped_static += skipped
+                self.liveness_batches += 1
+                self._count(
+                    "columns_skipped_static_total", skipped, target=target
+                )
         feats = [
             handler.encode_review_features(r, ns_cache, vocab)
             for r in reviews
@@ -942,7 +988,7 @@ class TpuDriver(RegoDriver):
         if g1 > G_CAP:
             g1 = G_CAP
             row_fallback |= (table.idx1 >= G_CAP).any(axis=1)
-        return tok, _features_np(fb), (g, g1), row_fallback
+        return tok, _features_np(fb), (g, g1), row_fallback, skipped
 
     def _audit_corpus(self, target: str) -> Optional[_Corpus]:
         corpus = self._corpus.get(target)
@@ -954,7 +1000,7 @@ class TpuDriver(RegoDriver):
             self._corpus.pop(target, None)
             return None
         ns_cache = self._ns_cache(target)
-        tok, fb_dev, (g, g1), row_fallback = self._encode_reviews(
+        tok, fb_dev, (g, g1), row_fallback, _ = self._encode_reviews(
             target, reviews, ns_cache
         )
         corpus = _Corpus(
@@ -1006,8 +1052,9 @@ class TpuDriver(RegoDriver):
         self.patterns.sync()
         self.tables.sync()
         overlay = OverlayVocab(self.vocab)
-        tok, fb_dev, (g, g1), row_fallback = self._encode_reviews(
-            target, reviews, ns_cache, vocab=overlay
+        keep_fn = self._liveness_keep_fn(cs, overlay)
+        tok, fb_dev, (g, g1), row_fallback, skipped = self._encode_reviews(
+            target, reviews, ns_cache, vocab=overlay, keep_fn=keep_fn
         )
         v_base = overlay.base_len
         # fill table rows + pattern rows for overlay entries to a fixed
@@ -1052,7 +1099,107 @@ class TpuDriver(RegoDriver):
             ov_member=ov_member,
             ov_capture=ov_capture,
             ov_tabs=ov_tabs,
+            skipped_static=skipped,
         )
+
+    # -- IR static-analysis plane (analysis/ir.py) ---------------------------
+
+    def _cs_live_pids(self, cs: _ConstraintSet) -> Optional[frozenset]:
+        """Live pattern indices over this set's compiled programs,
+        computed once per set and cached on it. None means keep-all:
+        some program failed the pad-equivalence proof (or the analysis
+        itself failed — refuse, never guess)."""
+        if cs.live_pids is False:
+            from ..analysis.ir import corpus_liveness
+
+            try:
+                cs.live_pids = corpus_liveness(cs.programs)
+            except Exception:
+                cs.live_pids = None
+        return cs.live_pids
+
+    def _liveness_keep_fn(
+        self, cs: _ConstraintSet, vocab: Any
+    ) -> Optional[Callable[[int], bool]]:
+        """Token keep-predicate for encoding a batch that only this
+        set's programs will read: spath vocab id -> does the path match
+        ANY live pattern. None disables filtering (liveness off, or the
+        set is not provably maskable). Subset sets get their own
+        (tighter) mask — each subset dispatch encodes its own ephemeral
+        corpus, so set-scoped liveness is sound."""
+        if not self.liveness_enabled:
+            return None
+        live = self._cs_live_pids(cs)
+        if live is None:
+            return None
+        pat_segs = [self.patterns.segs(p) for p in sorted(live)]
+        memo: Dict[int, bool] = {}
+
+        def keep(pid: int) -> bool:
+            hit = memo.get(pid)
+            if hit is None:
+                s = vocab.string(pid)
+                if isinstance(s, str) and s.startswith("p:"):
+                    segs = s[2:].split(".")
+                    hit = any(_match(ps, segs)[0] for ps in pat_segs)
+                else:
+                    hit = True  # not a path entry: refuse to drop
+                memo[pid] = hit
+            return hit
+
+        return keep
+
+    def liveness_stats(self) -> Dict[str, Any]:
+        """Liveness-plane counters, the driver side of decision facts
+        and /debug/partitions."""
+        return {
+            "enabled": self.liveness_enabled,
+            "columns_skipped_static": self.columns_skipped_static,
+            "liveness_batches": self.liveness_batches,
+        }
+
+    def ir_report(self, target: str):
+        """IR static-analysis report (analysis/ir.py IrReport) over the
+        target's current compiled constraint set: GK-P0xx diagnostics,
+        fused-path taxonomy, liveness summary, and specialization
+        certificates. Lazily computed once per constraint generation;
+        attach_ir_report pre-populates across warm swaps (the
+        attach_report contract)."""
+        ent = self._ir_reports.get(target)
+        if ent is not None and ent[0] == self._constraint_gen:
+            return ent[1]
+        from ..analysis.ir import ir_from_programs
+
+        with self._mutex:
+            cs = self._constraint_set(target)
+            if cs is None:
+                return None
+            gen = self._constraint_gen
+            items = []
+            for c, prog in zip(cs.constraints, cs.programs):
+                kind = c.get("kind")
+                name = (c.get("metadata") or {}).get("name", "")
+                items.append(
+                    (
+                        f"constraint:{kind}/{name}",
+                        kind,
+                        prog,
+                        H.constraint_parameters(c),
+                    )
+                )
+            rep = ir_from_programs(items, fallback_codes=cs.fallback_codes)
+            rep.liveness["patterns_total"] = self.patterns.n_patterns
+            self._ir_reports[target] = (gen, rep)
+        return rep
+
+    def attach_ir_report(self, target: str, report: Any) -> None:
+        """Re-attach an already-computed IR report after a module swap,
+        so the IR plane (stats.analysis.ir, /debug views) never goes
+        blank under churn — the attach_report contract."""
+        if report is None:
+            return
+        with self._mutex:
+            self._ir_reports[target] = (self._constraint_gen, report)
 
     # -- device dispatch -----------------------------------------------------
 
@@ -2106,6 +2253,11 @@ class TpuDriver(RegoDriver):
                 "render_errors": self._render_errors,
                 "render_cache_evictions": self._render_cache_evictions,
                 "hot_redispatches": self._hot_redispatches,
+                # dead token slots the IR liveness mask dropped from
+                # THIS batch's encode (0 for the keep-all audit corpus)
+                "columns_skipped_static": int(
+                    getattr(corpus, "skipped_static", 0)
+                ),
                 "phase_seconds": phase_seconds,
                 # machine-readable WHY for every wholesale-interpreter
                 # template in this query's constraint set

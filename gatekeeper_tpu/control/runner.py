@@ -958,6 +958,40 @@ class Runner:
                         stats["analysis"] = {
                             "corpus": corpus.snapshot()
                         }
+                    # IR static-analysis headline (docs/analysis.md
+                    # §IR analysis): liveness-plane counters + the
+                    # per-target report rollup (reads the cached
+                    # report; first touch computes it once per
+                    # constraint generation)
+                    if drv is not None and hasattr(
+                        drv, "liveness_stats"
+                    ):
+                        ir: Dict[str, Any] = drv.liveness_stats()
+                        # the admission target name lives on the
+                        # webhook's batcher (WebhookServer itself
+                        # holds no target attr)
+                        tgt = getattr(
+                            getattr(
+                                runner.webhook, "batcher", None
+                            ),
+                            "target",
+                            "admission.k8s.gatekeeper.sh",
+                        )
+                        try:
+                            rep = drv.ir_report(tgt)
+                        except Exception:
+                            rep = None
+                        if rep is not None:
+                            ir.update({
+                                "ok": rep.ok,
+                                "subjects": rep.subjects,
+                                "counts": rep.counts(),
+                                "liveness": rep.liveness,
+                                "certificates": len(
+                                    rep.certificates
+                                ),
+                            })
+                        stats.setdefault("analysis", {})["ir"] = ir
                     payload = json.dumps(
                         {"ready": ok, "stats": stats}
                     ).encode()

@@ -37,6 +37,7 @@ reference library with well-formed K8s objects):
 
 from __future__ import annotations
 
+import enum
 import json
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -80,6 +81,46 @@ NEG_INF = -(10.0**30)
 ORACLE_MEMO_VERSION = 1
 
 
+class Reason(str, enum.Enum):
+    """Stable fused-path fallback taxonomy.
+
+    Every `CompileUnsupported` raise site stamps one of these family
+    codes, so consumers (the IR static-analysis plane's GK-P015
+    diagnostic, fallback metrics, tests) classify *why* a template fell
+    off the fused path by enum identity instead of string-matching
+    human-oriented messages. Values are stable slugs: renaming a member
+    is an API break; add new members instead."""
+
+    AGGREGATE_ARG = "aggregate-arg"
+    ARRAY_DEPTH = "array-depth"
+    AXIS_SHAPE = "axis-shape"
+    BINOP = "binop"
+    BUILTIN_ARG_SHAPE = "builtin-arg-shape"
+    COMPARISON = "comparison"
+    COMPREHENSION = "comprehension"
+    DATA_REF = "data-ref"
+    DERIVED_VALUE = "derived-value"
+    DESTRUCTURING = "destructuring"
+    EXPR_FORM = "expr-form"
+    EXTERNAL_DATA = "external-data"
+    FIXED_INDEX = "fixed-index"
+    FORKING = "forking"
+    FUNCTION_CALL = "function-call"
+    INPUT_REF = "input-ref"
+    KEYED_LOOKUP = "keyed-lookup"
+    OBJECT_ITERATION = "object-iteration"
+    OTHER = "other"
+    PARTIAL_SET = "partial-set"
+    PROJECTION = "projection"
+    RULE_REF = "rule-ref"
+    TERM_FORM = "term-form"
+    TRUTHINESS = "truthiness"
+    UNSUPPORTED_BUILTIN = "unsupported-builtin"
+    VIOLATION_RULE_FORM = "violation-rule-form"
+    WALK_FORM = "walk-form"
+    WITH_MODIFIER = "with-modifier"
+
+
 class CompileUnsupported(Exception):
     """Template uses constructs outside the compilable subset.
 
@@ -87,7 +128,8 @@ class CompileUnsupported(Exception):
     exception unwinds through the clause compiler (`_compile_clause`
     stamps rule+line, `compile_violation_counts` stamps the kind), so
     fallback log lines and analyzer-mismatch reports cite WHERE
-    compilation gave up, not just why."""
+    compilation gave up, not just why. `code` is the stable `Reason`
+    family the raise site belongs to (never derived from the message)."""
 
     def __init__(
         self,
@@ -95,11 +137,13 @@ class CompileUnsupported(Exception):
         kind: str = "",
         rule: str = "",
         line: int = 0,
+        code: Optional[Reason] = None,
     ):
         self.reason = reason
         self.kind = kind
         self.rule = rule
         self.line = line
+        self.code = code if code is not None else Reason.OTHER
         super().__init__(reason)
 
     def annotate(
@@ -265,7 +309,7 @@ def _axes_of(prefix: Tuple[str, ...]) -> Tuple[str, ...]:
     if n == 2:
         # two array levels flatten onto one combined axis (idx0*G1 + idx1)
         return ("g01",)
-    raise CompileUnsupported(">2 array levels")
+    raise CompileUnsupported(">2 array levels", code=Reason.ARRAY_DEPTH)
 
 
 @dataclass
@@ -308,17 +352,22 @@ class SScalar(SVal):
     def _grouped(self, mask, value, how, init=-1):
         if self.axes in (("g0",), ("g01",)):
             return EGroup(mask, value, self.axes[0], how=how, init=init)
-        raise CompileUnsupported(f"axes {self.axes}")
+        raise CompileUnsupported(f"axes {self.axes}", code=Reason.AXIS_SHAPE)
 
     def col(self, name: str, init=-1) -> Expr:
         if self.num_override is not None:
-            raise CompileUnsupported("column of derived scalar")
+            raise CompileUnsupported("column of derived scalar", code=Reason.DERIVED_VALUE)
         if self.tok_space:
             return ETokCol(name)
         if not self.axes:
+            # "maskfill" is an IR contract with analysis/ir.py: args are
+            # [mask, value] and the result is a constant fill wherever
+            # the mask is False, so a provably-False mask makes the node
+            # pad-equivalent regardless of the value column.
             masked = EMap(
                 lambda np_, m, v: np_.where(m, v, init),
                 [self.sel(), ETokCol(name)],
+                "maskfill",
             )
             return EReduce(masked, "max")
         return self._grouped(self.sel(), ETokCol(name), "max", init=init)
@@ -418,7 +467,7 @@ class STokenSet(SVal):
             return EReduce(m, "any")
         if self.axes == ("g0",):
             return EGroup(m, None, "g0", how="any")
-        raise CompileUnsupported("token-set axes")
+        raise CompileUnsupported("token-set axes", code=Reason.AXIS_SHAPE)
 
     def reduce_count(self) -> Expr:
         cnt = EMap(lambda np_, m: m.astype(np.int32), [self.mask], "toint")
@@ -426,7 +475,7 @@ class STokenSet(SVal):
             return EReduce(cnt, "sum")
         if self.axes == ("g0",):
             return EGroup(self.mask, cnt, "g0", how="sum")
-        raise CompileUnsupported("token-set axes")
+        raise CompileUnsupported("token-set axes", code=Reason.AXIS_SHAPE)
 
 
 @dataclass
@@ -497,7 +546,7 @@ def _space_join(a: Tuple[str, ...], b: Tuple[str, ...]) -> Tuple[str, ...]:
 
     j = join_spaces(a, b)
     if j is None:
-        raise CompileUnsupported(f"space join {a} {b}")
+        raise CompileUnsupported(f"space join {a} {b}", code=Reason.AXIS_SHAPE)
     return j
 
 
@@ -680,12 +729,12 @@ class Compiler:
     def _compile_violation_counts(self) -> Expr:
         clauses = self.rules.get("violation")
         if not clauses:
-            raise CompileUnsupported("no violation rule")
+            raise CompileUnsupported("no violation rule", code=Reason.VIOLATION_RULE_FORM)
         branches: List[Tuple[Any, Tuple[str, ...], Expr, Optional[Expr], Any]]
         branches = []
         for rule in clauses:
             if rule.is_default or rule.else_rule is not None:
-                raise CompileUnsupported("default/else violation rule")
+                raise CompileUnsupported("default/else violation rule", code=Reason.VIOLATION_RULE_FORM)
             try:
                 branches.extend(self._compile_clause(rule))
             except CompileUnsupported as e:
@@ -864,7 +913,7 @@ class Compiler:
             # element-projected conditions reached the counting head:
             # one element spans many tokens, so the count would inflate.
             # Abort; compile_program retries with projection disabled.
-            raise CompileUnsupported("unreduced element projection")
+            raise CompileUnsupported("unreduced element projection", code=Reason.PROJECTION)
         outs: List[Tuple[Any, Tuple[str, ...], Expr, Optional[Expr], Any]] = []
         for st in finals:
             # the head must evaluate too (undefined heads drop violations);
@@ -937,7 +986,7 @@ class Compiler:
                 return []
             states = nxt
             if len(states) > 64:
-                raise CompileUnsupported("fork explosion")
+                raise CompileUnsupported("fork explosion", code=Reason.FORKING)
         return states
 
     def _eval_expr(self, expr: A.Expr, st: State) -> List[State]:
@@ -967,13 +1016,13 @@ class Compiler:
         if isinstance(expr, A.NotExpr):
             return self._eval_not(expr.expr, st)
         if isinstance(expr, A.WithExpr):
-            raise CompileUnsupported("with modifier")
-        raise CompileUnsupported(f"expr {type(expr).__name__}")
+            raise CompileUnsupported("with modifier", code=Reason.WITH_MODIFIER)
+        raise CompileUnsupported(f"expr {type(expr).__name__}", code=Reason.EXPR_FORM)
 
     def _node_exists_cond(self, node: SNode) -> Optional[Expr]:
         """Definedness of an abstract node (any token beneath it)."""
         if "*" in node.prefix:
-            raise CompileUnsupported("existence under object iteration")
+            raise CompileUnsupported("existence under object iteration", code=Reason.OBJECT_ITERATION)
         pat = self._pattern(node.prefix + ("**",))
         axes = _axes_of(node.prefix)
         sel = ESelPattern(pat)
@@ -981,7 +1030,7 @@ class Compiler:
             return EReduce(sel, "any")
         if axes in (("g0",), ("g01",)):
             return EGroup(sel, None, axes[0], how="any")
-        raise CompileUnsupported("existence axes")
+        raise CompileUnsupported("existence axes", code=Reason.AXIS_SHAPE)
 
     def _eval_assign(self, target, value, st: State) -> List[State]:
         if isinstance(target, A.Wildcard):
@@ -989,7 +1038,7 @@ class Compiler:
         if isinstance(target, A.ArrayTerm):
             return self._eval_destructure(target, value, st)
         if not isinstance(target, A.Var):
-            raise CompileUnsupported("destructuring assignment")
+            raise CompileUnsupported("destructuring assignment", code=Reason.DESTRUCTURING)
         out = []
         for val, st2 in self._eval_term(value, st):
             if isinstance(val, SNode) and not val.prefix[-1:] == ("#",):
@@ -1020,7 +1069,7 @@ class Compiler:
             if isinstance(t, (A.Var, A.Wildcard)):
                 vars_.append(t)
             else:
-                raise CompileUnsupported("destructure target shape")
+                raise CompileUnsupported("destructure target shape", code=Reason.DESTRUCTURING)
         if (
             isinstance(value, A.Call)
             and value.name == "split"
@@ -1031,7 +1080,7 @@ class Compiler:
                 if not isinstance(sep_v, SConst) or not isinstance(
                     sep_v.value, str
                 ):
-                    raise CompileUnsupported("split separator shape")
+                    raise CompileUnsupported("split separator shape", code=Reason.BUILTIN_ARG_SHAPE)
                 sep = sep_v.value
                 for tgt_v, st2 in self._eval_term(value.args[0], st1):
                     tgt_v = self._leafify(tgt_v)
@@ -1083,7 +1132,7 @@ class Compiler:
             ):
                 items = [SConst(x) for x in val.value]
             if items is None:
-                raise CompileUnsupported("destructure value shape")
+                raise CompileUnsupported("destructure value shape", code=Reason.DESTRUCTURING)
             env = dict(st2.env)
             for t, v in zip(vars_, items):
                 if isinstance(t, A.Var):
@@ -1151,7 +1200,7 @@ class Compiler:
             # the negation cannot existentially close the projection's
             # token axis (the outer space already holds an UNRELATED
             # token iteration) — mixing their token conds would misjoin
-            raise CompileUnsupported("projection under open token axis")
+            raise CompileUnsupported("projection under open token axis", code=Reason.PROJECTION)
         exprs = []
         statically_true = False
         for f in finals:
@@ -1196,9 +1245,9 @@ class Compiler:
                 return [(SInput(), st)]
             if term.name in self.rules:
                 return self._eval_rule_ref(term.name, [], st)
-            raise CompileUnsupported(f"unbound var {term.name}")
+            raise CompileUnsupported(f"unbound var {term.name}", code=Reason.TERM_FORM)
         if isinstance(term, A.Wildcard):
-            raise CompileUnsupported("wildcard term")
+            raise CompileUnsupported("wildcard term", code=Reason.TERM_FORM)
         if isinstance(term, A.Ref):
             return self._eval_ref(term, st)
         if isinstance(term, A.Call):
@@ -1227,9 +1276,9 @@ class Compiler:
                 if isinstance(v, SConst) and isinstance(v.value, (int, float)):
                     out.append((SConst(-v.value), s))
                 else:
-                    raise CompileUnsupported("symbolic unary minus")
+                    raise CompileUnsupported("symbolic unary minus", code=Reason.TERM_FORM)
             return out
-        raise CompileUnsupported(f"term {type(term).__name__}")
+        raise CompileUnsupported(f"term {type(term).__name__}", code=Reason.TERM_FORM)
 
     def _eval_seq_literal(self, items, st: State, kind: str):
         vals, cur = [], st
@@ -1239,7 +1288,7 @@ class Compiler:
             if not forks:
                 return []  # undefined element -> literal undefined
             if len(forks) != 1:
-                raise CompileUnsupported("forking literal element")
+                raise CompileUnsupported("forking literal element", code=Reason.FORKING)
             v, cur = forks[0]
             if not isinstance(v, SConst):
                 symbolic = True
@@ -1258,11 +1307,11 @@ class Compiler:
         for k, v in term.items:
             kf = self._eval_term(k, cur)
             if len(kf) != 1:
-                raise CompileUnsupported("forking object key")
+                raise CompileUnsupported("forking object key", code=Reason.FORKING)
             kv, cur = kf[0]
             vf = self._eval_term(v, cur)
             if len(vf) != 1:
-                raise CompileUnsupported("forking object value")
+                raise CompileUnsupported("forking object value", code=Reason.FORKING)
             vv, cur = vf[0]
             if isinstance(kv, SConst) and isinstance(vv, SConst):
                 concrete[_hashable(kv.value)] = vv.value
@@ -1291,17 +1340,17 @@ class Compiler:
 
     def _eval_ref(self, ref: A.Ref, st: State):
         if not isinstance(ref.head, A.Var):
-            raise CompileUnsupported("computed ref head")
+            raise CompileUnsupported("computed ref head", code=Reason.INPUT_REF)
         name = ref.head.name
         if name == "input":
             if not ref.ops or not isinstance(ref.ops[0], A.Scalar):
-                raise CompileUnsupported("opaque input access")
+                raise CompileUnsupported("opaque input access", code=Reason.INPUT_REF)
             first = ref.ops[0].value
             if first == "parameters":
                 return self._walk(SConst(self.params), ref.ops[1:], st)
             if first == "review":
                 return self._walk(SNode(prefix=()), ref.ops[1:], st)
-            raise CompileUnsupported(f"input.{first}")
+            raise CompileUnsupported(f"input.{first}", code=Reason.INPUT_REF)
         if name in st.env:
             return self._walk(st.env[name], ref.ops, st)
         if name in self.rules:
@@ -1323,8 +1372,8 @@ class Compiler:
                     ref.ops[1:],
                     st,
                 )
-            raise CompileUnsupported("data ref outside inventory")
-        raise CompileUnsupported(f"unknown ref head {name}")
+            raise CompileUnsupported("data ref outside inventory", code=Reason.DATA_REF)
+        raise CompileUnsupported(f"unknown ref head {name}", code=Reason.INPUT_REF)
 
     def _walk(self, val: SVal, ops: List[A.Term], st: State):
         forks: List[Tuple[SVal, State]] = [(val, st)]
@@ -1379,7 +1428,7 @@ class Compiler:
                 return [(SConst(self.params), st)]
             if isinstance(op, A.Scalar) and op.value == "review":
                 return [(SNode(prefix=()), st)]
-            raise CompileUnsupported("opaque input walk")
+            raise CompileUnsupported("opaque input walk", code=Reason.INPUT_REF)
         if isinstance(val, SConst):
             return self._walk_const(val.value, op, st)
         if isinstance(val, SNode):
@@ -1421,7 +1470,7 @@ class Compiler:
                 isinstance(op, A.Var) and op.name in st.env
             ):
                 if val.axes:
-                    raise CompileUnsupported("iterating per-axis token set")
+                    raise CompileUnsupported("iterating per-axis token set", code=Reason.WALK_FORM)
                 elem = SScalar(
                     self,
                     pattern_idx=-1,
@@ -1434,8 +1483,8 @@ class Compiler:
                 st2 = replace(st, space=_space_join(st.space, ("tok",)))
                 st2 = replace(st2, cond=st2.cond + [val.mask])
                 return [(elem, st2)]
-            raise CompileUnsupported("walking a comprehension result")
-        raise CompileUnsupported(f"walk {type(val).__name__}")
+            raise CompileUnsupported("walking a comprehension result", code=Reason.WALK_FORM)
+        raise CompileUnsupported(f"walk {type(val).__name__}", code=Reason.WALK_FORM)
 
     def _walk_const(self, value: Any, op: A.Term, st: State):
         if isinstance(op, A.Scalar):
@@ -1472,7 +1521,7 @@ class Compiler:
                     env[bind] = SConst(k)
                 out.append((SConst(v), replace(st, env=env)))
             return out
-        raise CompileUnsupported("const walk op")
+        raise CompileUnsupported("const walk op", code=Reason.WALK_FORM)
 
     def _lookup_symbolic(self, container: Any, key: SVal, st: State):
         """concrete_container[symbolic_key] — membership/lookup condition."""
@@ -1485,7 +1534,7 @@ class Compiler:
                 keys = list(container)
             str_keys = [k for k in keys if isinstance(k, str)]
             if len(str_keys) != len(keys):
-                raise CompileUnsupported("non-string symbolic lookup keys")
+                raise CompileUnsupported("non-string symbolic lookup keys", code=Reason.KEYED_LOOKUP)
             ids = [self.vocab.str_id(k) for k in str_keys]
             slot = self.pool.id_set(ids)
             self.signature.append(("idset", len(self.pool.values[slot])))
@@ -1494,7 +1543,7 @@ class Compiler:
             elif isinstance(key, SScalar) and key.num_override is None:
                 cond = e_and(key.exists(), EIsInConst(key.vid(), slot))
             else:
-                raise CompileUnsupported("symbolic lookup key shape")
+                raise CompileUnsupported("symbolic lookup key shape", code=Reason.KEYED_LOOKUP)
             # the VALUE is only usable when all container values are equal
             # or the result is used as a condition; return an opaque truthy
             # value guarded by membership (values in these templates are
@@ -1518,7 +1567,7 @@ class Compiler:
             if not isinstance(op.value, str):
                 return self._iterate_indexed(node, op, st)
             if "*" in node.prefix:
-                raise CompileUnsupported("field access under object iteration")
+                raise CompileUnsupported("field access under object iteration", code=Reason.OBJECT_ITERATION)
             return [(SNode(node.prefix + (esc_seg(op.value),)), st)]
         if isinstance(op, A.Var) and op.name in st.env:
             kv = st.env[op.name]
@@ -1526,27 +1575,27 @@ class Compiler:
                 if isinstance(kv.value, str):
                     return [(SNode(node.prefix + (esc_seg(kv.value),)), st)]
                 if kv.value is _ARRAY_INDEX:
-                    raise CompileUnsupported("array index used as key")
+                    raise CompileUnsupported("array index used as key", code=Reason.KEYED_LOOKUP)
                 return []
             if isinstance(kv, (SKey, SScalar)):
                 return self._iterate_keyed_bound(node, kv, st)
-            raise CompileUnsupported("bound node key shape")
+            raise CompileUnsupported("bound node key shape", code=Reason.KEYED_LOOKUP)
         if isinstance(op, (A.Wildcard, A.Var)):
             return self._iterate_node(node, op, st)
-        raise CompileUnsupported("node walk op")
+        raise CompileUnsupported("node walk op", code=Reason.WALK_FORM)
 
     def _iterate_indexed(self, node: SNode, op: A.Scalar, st: State):
         """containers[0] — fixed array index."""
         idx = op.value
         if not (isinstance(idx, (int, float)) and int(idx) == idx):
             return []
-        raise CompileUnsupported("fixed array index")
+        raise CompileUnsupported("fixed array index", code=Reason.FIXED_INDEX)
 
     def _iterate_keyed_bound(self, node: SNode, key: SVal, st: State):
         """node[k] with k already bound to a symbolic key — equality join
         between the capture and the bound key (labels[key] pattern)."""
         if "*" in node.prefix or "#" in node.prefix:
-            raise CompileUnsupported("keyed join under iteration")
+            raise CompileUnsupported("keyed join under iteration", code=Reason.KEYED_LOOKUP)
         pat = self._pattern(node.prefix + ("*", "**"))
         scalar = SScalar(self, pat, axes=(), tok_space=True)
         if isinstance(key, SKey):
@@ -1554,7 +1603,7 @@ class Compiler:
         elif isinstance(key, SScalar) and key.num_override is None:
             cond = e_and(key.exists(), e_cmp("==", ECapture(pat), key.vid()))
         else:
-            raise CompileUnsupported("keyed join key shape")
+            raise CompileUnsupported("keyed join key shape", code=Reason.KEYED_LOOKUP)
         st2 = replace(
             st,
             cond=st.cond + [e_and(scalar.sel(), cond)],
@@ -1645,7 +1694,7 @@ class Compiler:
                 # iteration (real data there is an array, matched by the
                 # sibling fork): this fork contributes nothing
                 return []
-            raise CompileUnsupported("iteration not representable")
+            raise CompileUnsupported("iteration not representable", code=Reason.WALK_FORM)
         return forks
 
     def _elem_proj_fork(
@@ -1669,7 +1718,7 @@ class Compiler:
     def _walk_elem_proj(self, val: SElemProj, op: A.Term, st: State):
         if isinstance(op, A.Scalar):
             if not isinstance(op.value, str):
-                raise CompileUnsupported("indexed walk under projection")
+                raise CompileUnsupported("indexed walk under projection", code=Reason.PROJECTION)
             return [
                 (replace(val, rel=val.rel + (esc_seg(op.value),)), st)
             ]
@@ -1679,7 +1728,7 @@ class Compiler:
             # nested array under the projected element (volumeMounts[_])
             root2 = val.root + val.rel + ("#",)
             if root2.count("#") > 2:
-                raise CompileUnsupported(">2 array levels in projection")
+                raise CompileUnsupported(">2 array levels in projection", code=Reason.ARRAY_DEPTH)
             elem_any = self._pattern(root2 + ("**",))
             child = SElemProj(root=root2, rel=())
             env = dict(st.env)
@@ -1693,7 +1742,7 @@ class Compiler:
                 proj=True,
             )
             return [(child, st2)]
-        raise CompileUnsupported("projection walk op")
+        raise CompileUnsupported("projection walk op", code=Reason.PROJECTION)
 
     def _elem_proj_scalar(self, v: SElemProj) -> SScalar:
         """Projected subfield read: the element's per-field value
@@ -1701,7 +1750,7 @@ class Compiler:
         from .exprs import EGatherElem
 
         if not v.rel:
-            raise CompileUnsupported("whole projected element as value")
+            raise CompileUnsupported("whole projected element as value", code=Reason.PROJECTION)
         ax = "g0" if v.root.count("#") == 1 else "g01"
         pat_f = self._pattern(v.root + v.rel)
         elem_any = self._pattern(v.root + ("**",))
@@ -1739,7 +1788,7 @@ class Compiler:
         from .exprs import EGatherElem
 
         if not v.rel:
-            raise CompileUnsupported("bare projected element truthiness")
+            raise CompileUnsupported("bare projected element truthiness", code=Reason.PROJECTION)
         ax = "g0" if v.root.count("#") == 1 else "g01"
         deep = self._pattern(v.root + v.rel + ("**",))
         exact = self._pattern(v.root + v.rel)
@@ -1760,7 +1809,7 @@ class Compiler:
 
     def _node_leaf(self, node: SNode) -> SScalar:
         if "*" in node.prefix:
-            raise CompileUnsupported("leaf under object iteration")
+            raise CompileUnsupported("leaf under object iteration", code=Reason.OBJECT_ITERATION)
         pat = self._pattern(node.prefix)
         return SScalar(self, pat, axes=_axes_of(node.prefix))
 
@@ -1769,7 +1818,7 @@ class Compiler:
         kind = rules[0].head.kind
         if kind == "set":
             if not ops:
-                raise CompileUnsupported("bare partial-set ref as value")
+                raise CompileUnsupported("bare partial-set ref as value", code=Reason.RULE_REF)
             out: List[Tuple[SVal, State]] = []
             for rule in rules:
                 for v, s in self._iterate_partial_set(rule, ops[0], st):
@@ -1787,15 +1836,15 @@ class Compiler:
                     with self._inv_barrier():
                         finals = self._eval_body(rule.body, sub)
                     if len(finals) != 1 or finals[0].cond or finals[0].space:
-                        raise CompileUnsupported("computed complete rule")
+                        raise CompileUnsupported("computed complete rule", code=Reason.RULE_REF)
                     forks = self._eval_term(rule.head.value, finals[0])
                     forks = [(v, st) for v, _ in forks]
                 out = []
                 for v, s in forks:
                     out.extend(self._walk(v, ops, s))
                 return out
-            raise CompileUnsupported("computed complete rule ref")
-        raise CompileUnsupported(f"rule ref {kind}")
+            raise CompileUnsupported("computed complete rule ref", code=Reason.RULE_REF)
+        raise CompileUnsupported(f"rule ref {kind}", code=Reason.RULE_REF)
 
     def _iterate_partial_set(self, rule: A.Rule, op: A.Term, st: State):
         """Iterate/match a same-module partial set rule.
@@ -1814,12 +1863,19 @@ class Compiler:
             head_map = {}
             for hk, hval in rule.head.key.items:
                 if not isinstance(hk, A.Scalar):
-                    raise CompileUnsupported("computed head key field")
+                    raise CompileUnsupported("computed head key field", code=Reason.PARTIAL_SET)
                 head_map[hk.value] = hval
-            if set(head_map) != {
+            # interpreter object-pattern semantics are SUBSET match:
+            # every caller field must exist in the head element, extra
+            # head fields are ignored (interp.py:_bind_pattern). A
+            # caller field the head lacks can never unify.
+            caller_keys = {
                 k.value for k, _ in op.items if isinstance(k, A.Scalar)
-            } or len(op.items) != len(head_map):
-                return []  # field sets differ: no match
+            }
+            if len(caller_keys) != len(op.items):
+                raise CompileUnsupported("computed pattern field key", code=Reason.PARTIAL_SET)
+            if not caller_keys <= set(head_map):
+                return []  # caller field missing from head: no match
             for k, v in op.items:
                 hterm = head_map[k.value]
                 if isinstance(v, A.Var) and v.name not in st.env:
@@ -1829,7 +1885,7 @@ class Compiler:
                     continue
                 vf = self._eval_term(v, st)
                 if len(vf) != 1 or not isinstance(vf[0][0], SConst):
-                    raise CompileUnsupported("non-const pattern field")
+                    raise CompileUnsupported("non-const pattern field", code=Reason.PARTIAL_SET)
                 cv = vf[0][0]
                 if isinstance(hterm, A.Var):
                     pre_env[hterm.name] = cv
@@ -1837,9 +1893,9 @@ class Compiler:
                     if hterm.value != cv.value:
                         return []  # statically mismatched clause
                 else:
-                    raise CompileUnsupported("head field shape")
+                    raise CompileUnsupported("head field shape", code=Reason.PARTIAL_SET)
         elif not isinstance(op, (A.Var, A.Wildcard)):
-            raise CompileUnsupported("partial-set operand shape")
+            raise CompileUnsupported("partial-set operand shape", code=Reason.PARTIAL_SET)
 
         sub = State(env=pre_env, space=st.space, guards=dict(st.guards), axis_owner=dict(st.axis_owner))
         with self._inv_barrier():
@@ -1861,7 +1917,7 @@ class Compiler:
                 for var_name, hterm in post_binds:
                     bf = self._eval_term(hterm, hs)
                     if len(bf) != 1:
-                        raise CompileUnsupported("forking head field")
+                        raise CompileUnsupported("forking head field", code=Reason.FORKING)
                     env[var_name] = bf[0][0]
                 merged = replace(merged, env=env)
                 out.append((hv, merged))
@@ -1927,9 +1983,7 @@ class Compiler:
             # response cache, so clean cache-hit rows stay fused and
             # only cold-miss/error rows take the interpreter rung
             if not self.screen_mode:
-                raise CompileUnsupported(
-                    "external_data (compiles as a batch-prefetched screen)"
-                )
+                raise CompileUnsupported("external_data (compiles as a batch-prefetched screen)", code=Reason.EXTERNAL_DATA)
             self.uses_inventory = True
             self.opaque = True
             feat = self.cenv.extdata_feature
@@ -1966,20 +2020,20 @@ class Compiler:
             if name in BUILTINS:
                 arity, fn = BUILTINS[name]
                 if arity != len(args):
-                    raise CompileUnsupported(f"{name} arity")
+                    raise CompileUnsupported(f"{name} arity", code=Reason.FUNCTION_CALL)
                 try:
                     v = fn(*[freeze(a.value) for a in args])
                 except BuiltinError:
                     return []
                 return [(SConst(thaw(v)), st)]
-        raise CompileUnsupported(f"builtin {name} symbolic")
+        raise CompileUnsupported(f"builtin {name} symbolic", code=Reason.UNSUPPORTED_BUILTIN)
 
     def _inline_function(self, name: str, args: List[SVal], st: State):
         if self._fn_depth > 8:
-            raise CompileUnsupported("inline depth")
+            raise CompileUnsupported("inline depth", code=Reason.FUNCTION_CALL)
         rules = self.rules[name]
         if rules[0].head.kind != "func":
-            raise CompileUnsupported(f"{name} not a function")
+            raise CompileUnsupported(f"{name} not a function", code=Reason.FUNCTION_CALL)
         try:
             return self._inline_function_body(name, rules, args, st)
         except CompileUnsupported:
@@ -2025,10 +2079,10 @@ class Compiler:
                                 actual, SConst(formal.value)
                             )
                             if not okk:
-                                raise CompileUnsupported("formal pattern")
+                                raise CompileUnsupported("formal pattern", code=Reason.FUNCTION_CALL)
                             sub.cond.append(cond)
                     else:
-                        raise CompileUnsupported("formal pattern shape")
+                        raise CompileUnsupported("formal pattern shape", code=Reason.FUNCTION_CALL)
                 if not ok:
                     continue
                 with self._inv_barrier():
@@ -2367,8 +2421,8 @@ class Compiler:
                     return maybe
             return self._sym_arith(op, lv, rv, st)
         if op in ("&", "|"):
-            raise CompileUnsupported("symbolic set intersection/union")
-        raise CompileUnsupported(f"binop {op}")
+            raise CompileUnsupported("symbolic set intersection/union", code=Reason.BINOP)
+        raise CompileUnsupported(f"binop {op}", code=Reason.BINOP)
 
     def _mirror_pattern_for(
         self, inv: "SInventory", leaf_pid: int
@@ -2478,7 +2532,7 @@ class Compiler:
                 return (SConst(False), st)
             if op == "!=" and not isinstance(other, (int, float)):
                 return (SConst(True), st)
-            raise CompileUnsupported("comparison with array index")
+            raise CompileUnsupported("comparison with array index", code=Reason.COMPARISON)
         if op in ("==", "!=", "<", "<=", ">", ">="):
             c = rego_cmp(freeze(lv.value), freeze(rv.value))
             res = {
@@ -2494,7 +2548,7 @@ class Compiler:
         if isinstance(a, (set, frozenset)) and isinstance(b, (set, frozenset)):
             res = {"-": a - b, "&": a & b, "|": a | b}.get(op)
             if res is None:
-                raise CompileUnsupported("const set op")
+                raise CompileUnsupported("const set op", code=Reason.BINOP)
             return (SConst(res), st)
         if (
             isinstance(a, (int, float))
@@ -2512,7 +2566,7 @@ class Compiler:
                 "%": a % b if b != 0 else None,
             }[op]
             return (SConst(res), st)
-        raise CompileUnsupported("const binop types")
+        raise CompileUnsupported("const binop types", code=Reason.BINOP)
 
     def _set_difference(self, lv: SVal, rv: SVal, st: State):
         """Set difference where at least one side is token-derived."""
@@ -2521,7 +2575,7 @@ class Compiler:
                 return None
             elems = [v for v in lv.value]
             if not all(_is_scalar_const(v) for v in elems):
-                raise CompileUnsupported("const set of composites")
+                raise CompileUnsupported("const set of composites", code=Reason.BINOP)
             # count(missing) = #elems whose id never appears in the token set
             self.signature.append(("constdiff", len(elems)))
             if not elems:
@@ -2557,13 +2611,13 @@ class Compiler:
             mask = e_and(lv.mask, e_not(EIsInConst(lv.elem_ids, slot)))
             return (STokenSet(mask, lv.elem_ids, lv.axes), st)
         if isinstance(lv, STokenSet) and isinstance(rv, STokenSet):
-            raise CompileUnsupported("token-set minus token-set")
+            raise CompileUnsupported("token-set minus token-set", code=Reason.BINOP)
         return None
 
     def _sym_arith(self, op: str, lv: SVal, rv: SVal, st: State):
         ln, rn = self._as_num(lv), self._as_num(rv)
         if ln is None or rn is None:
-            raise CompileUnsupported("non-numeric arithmetic")
+            raise CompileUnsupported("non-numeric arithmetic", code=Reason.BINOP)
         val = e_arith(op, ln[0], rn[0])
         defined = e_and(ln[1], rn[1])
         if op in ("/", "%"):
@@ -2653,7 +2707,7 @@ class Compiler:
                     self.signature.append(("id",))
                     return e_cmp("==", lv.ids(), slot), True
                 return ELit(False), True
-            raise CompileUnsupported("eq const shape")
+            raise CompileUnsupported("eq const shape", code=Reason.COMPARISON)
         if isinstance(lv, SKey) and isinstance(rv, SScalar):
             lv, rv = rv, lv
         if isinstance(lv, SScalar) and isinstance(rv, SKey):
@@ -2694,7 +2748,7 @@ class Compiler:
         if op in ("==", "!="):
             cond, ok = self._sym_eq(lv, rv)
             if not ok:
-                raise CompileUnsupported("eq shapes")
+                raise CompileUnsupported("eq shapes", code=Reason.COMPARISON)
             if op == "!=":
                 defs = []
                 for v in (lv, rv):
@@ -2733,7 +2787,7 @@ class Compiler:
                 EStrTable(tname, lv.vid()),
             )
             return (SBool(cond), st)
-        raise CompileUnsupported(f"cmp {op} shapes")
+        raise CompileUnsupported(f"cmp {op} shapes", code=Reason.COMPARISON)
 
     # -- conditions ---------------------------------------------------------
 
@@ -2766,12 +2820,12 @@ class Compiler:
             return self._elem_proj_truthy(v)
         if isinstance(v, (SMsg, SKey, STokenSet, SList)):
             return True
-        raise CompileUnsupported(f"truthiness {type(v).__name__}")
+        raise CompileUnsupported(f"truthiness {type(v).__name__}", code=Reason.TRUTHINESS)
 
     def _node_truthy(self, node: SNode) -> Expr:
         """Node exists and is not the literal false."""
         if "*" in node.prefix:
-            raise CompileUnsupported("node truthy under object iteration")
+            raise CompileUnsupported("node truthy under object iteration", code=Reason.OBJECT_ITERATION)
         deep = self._pattern(node.prefix + ("**",))
         axes = _axes_of(node.prefix)
         exact = self._pattern(node.prefix)
@@ -2786,7 +2840,7 @@ class Compiler:
             return EReduce(good, "any")
         if axes in (("g0",), ("g01",)):
             return EGroup(good, None, axes[0], how="any")
-        raise CompileUnsupported("node truthy axes")
+        raise CompileUnsupported("node truthy axes", code=Reason.AXIS_SHAPE)
 
     # -- comprehensions ------------------------------------------------------
 
@@ -2799,7 +2853,7 @@ class Compiler:
         dimension.
         """
         if term.kind == "object":
-            raise CompileUnsupported("object comprehension")
+            raise CompileUnsupported("object comprehension", code=Reason.COMPREHENSION)
         sub = State(env=dict(st.env), space=st.space, guards=dict(st.guards), axis_owner=dict(st.axis_owner))
         with self._inv_barrier():
             finals = self._eval_body(term.body, sub)
@@ -2844,9 +2898,7 @@ class Compiler:
                     # projected conds are per-token stand-ins; a set
                     # comprehension would materialize per-token
                     # duplicates (count() over it would inflate)
-                    raise CompileUnsupported(
-                        "element projection in comprehension"
-                    )
+                    raise CompileUnsupported("element projection in comprehension", code=Reason.COMPREHENSION)
                 inner_conds = list(hs.cond)
                 if isinstance(hv, SKey):
                     mask: Expr = ESelPattern(hv.pattern_idx)
@@ -2870,14 +2922,14 @@ class Compiler:
                         )
                     )
                     if not ok:
-                        raise CompileUnsupported("comprehension axis mismatch")
+                        raise CompileUnsupported("comprehension axis mismatch", code=Reason.COMPREHENSION)
                     mask = hv.sel()
                     elem = ETokCol("vid")
                 else:
-                    raise CompileUnsupported("comprehension head shape")
+                    raise CompileUnsupported("comprehension head shape", code=Reason.COMPREHENSION)
                 for c in inner_conds:
                     if c.space not in ((), ("tok",)):
-                        raise CompileUnsupported("comprehension cond space")
+                        raise CompileUnsupported("comprehension cond space", code=Reason.COMPREHENSION)
                     mask = e_and(mask, c)
                 pieces.append((mask, elem))
         if not pieces:
@@ -2928,13 +2980,13 @@ class Compiler:
             # count-of-document usage (tls lists etc.); object/string counts
             # are not compiled.
             if "*" in v.prefix:
-                raise CompileUnsupported("count under object iteration")
+                raise CompileUnsupported("count under object iteration", code=Reason.OBJECT_ITERATION)
             child = v.prefix + ("#", "**")
             axes = _axes_of(child)
             pat = self._pattern(child)
             present = EGroupPresent(ESelPattern(pat), axes[-1])
             if len(axes) > 1:
-                raise CompileUnsupported("count of nested array")
+                raise CompileUnsupported("count of nested array", code=Reason.AGGREGATE_ARG)
             cnt = EReduce(
                 EMap(
                     lambda np_, p: p.astype(np.int32), [present], "toint"
@@ -2947,7 +2999,7 @@ class Compiler:
             deep = self._pattern(v.prefix + ("**",))
             exists = EReduce(ESelPattern(deep), "any")
             return [(SDerived(num=cnt, defined=exists), st)]
-        raise CompileUnsupported("count arg")
+        raise CompileUnsupported("count arg", code=Reason.AGGREGATE_ARG)
 
     def _builtin_any(self, args: List[SVal], st: State):
         (v,) = args
@@ -2986,7 +3038,7 @@ class Compiler:
                     st,
                 )
             ]
-        raise CompileUnsupported("any arg")
+        raise CompileUnsupported("any arg", code=Reason.AGGREGATE_ARG)
 
     def _builtin_all(self, args: List[SVal], st: State):
         (v,) = args
@@ -3012,12 +3064,12 @@ class Compiler:
             for e in exprs[1:]:
                 out = e_and(out, e)
             return [(SBool(out), st)]
-        raise CompileUnsupported("all arg")
+        raise CompileUnsupported("all arg", code=Reason.AGGREGATE_ARG)
 
     def _builtin_re_match(self, args, st: State):
         pat, target = args
         if not isinstance(pat, SConst) or not isinstance(pat.value, str):
-            raise CompileUnsupported("symbolic regex pattern")
+            raise CompileUnsupported("symbolic regex pattern", code=Reason.BUILTIN_ARG_SHAPE)
         if isinstance(target, SConst):
             import re as _re
 
@@ -3051,7 +3103,7 @@ class Compiler:
     def _strpred(self, args, st, mk, concrete):
         target, pat = args
         if not isinstance(pat, SConst) or not isinstance(pat.value, str):
-            raise CompileUnsupported("symbolic string-pred arg")
+            raise CompileUnsupported("symbolic string-pred arg", code=Reason.BUILTIN_ARG_SHAPE)
         if isinstance(target, SConst):
             if not isinstance(target.value, str):
                 return []
@@ -3095,7 +3147,7 @@ class Compiler:
     def _builtin_trim(self, args, st):
         target, cutset = args
         if not isinstance(cutset, SConst) or not isinstance(cutset.value, str):
-            raise CompileUnsupported("symbolic trim cutset")
+            raise CompileUnsupported("symbolic trim cutset", code=Reason.BUILTIN_ARG_SHAPE)
         c = cutset.value
         return self._str_transform(
             target, st, f"trim:{c}", lambda x, _c=c: x.strip(_c)
@@ -3104,7 +3156,7 @@ class Compiler:
     def _builtin_trim_prefix(self, args, st):
         target, pre = args
         if not isinstance(pre, SConst) or not isinstance(pre.value, str):
-            raise CompileUnsupported("symbolic trim_prefix arg")
+            raise CompileUnsupported("symbolic trim_prefix arg", code=Reason.BUILTIN_ARG_SHAPE)
         c = pre.value
         return self._str_transform(
             target,
@@ -3209,7 +3261,7 @@ class Compiler:
                     st,
                 )
             ]
-        raise CompileUnsupported("is_number arg")
+        raise CompileUnsupported("is_number arg", code=Reason.BUILTIN_ARG_SHAPE)
 
     def _builtin_is_string(self, args, st):
         (v,) = args
@@ -3231,7 +3283,7 @@ class Compiler:
                     st,
                 )
             ]
-        raise CompileUnsupported("is_string arg")
+        raise CompileUnsupported("is_string arg", code=Reason.BUILTIN_ARG_SHAPE)
 
     def _builtin_is_array(self, args, st):
         (v,) = args
@@ -3240,7 +3292,7 @@ class Compiler:
         if isinstance(v, SNode):
             # an array node has element tokens or the empty-array token
             if "*" in v.prefix:
-                raise CompileUnsupported("is_array under object iteration")
+                raise CompileUnsupported("is_array under object iteration", code=Reason.OBJECT_ITERATION)
             elem_pat = self._pattern(v.prefix + ("#", "**"))
             exact = self._pattern(v.prefix)
             axes = _axes_of(v.prefix)
@@ -3257,12 +3309,12 @@ class Compiler:
                 return [
                     (SBool(EGroup(arrish, None, axes[0], how="any")), st)
                 ]
-            raise CompileUnsupported("is_array axes")
+            raise CompileUnsupported("is_array axes", code=Reason.AXIS_SHAPE)
         if isinstance(v, (SScalar, SKey, SDerived)):
             return [(SConst(False), st)] if not isinstance(v, SScalar) else [
                 (SBool(ELit(False)), st)
             ]
-        raise CompileUnsupported("is_array arg")
+        raise CompileUnsupported("is_array arg", code=Reason.BUILTIN_ARG_SHAPE)
 
     def _builtin_to_number(self, args, st):
         (v,) = args
@@ -3291,7 +3343,7 @@ class Compiler:
                 e_or(kind_num, e_and(kind_str, parsed_def)),
             )
             return [(SDerived(num=val, defined=dfn), st)]
-        raise CompileUnsupported("to_number arg")
+        raise CompileUnsupported("to_number arg", code=Reason.BUILTIN_ARG_SHAPE)
 
     def _leafify(self, v: SVal) -> SVal:
         """Materialize an abstract node as a leaf read where a scalar is
@@ -3306,13 +3358,13 @@ class Compiler:
         v = self._leafify(v)
         if isinstance(v, SScalar):
             if v.num_override is not None:
-                raise CompileUnsupported("derived used as string")
+                raise CompileUnsupported("derived used as string", code=Reason.DERIVED_VALUE)
             return v.vid(), e_and(
                 v.exists(), e_cmp("==", v.kindv(), ELit(K_STR))
             )
         if isinstance(v, SKey):
             return v.ids(), e_cmp("!=", v.ids(), ELit(-1))
-        raise CompileUnsupported("string operand")
+        raise CompileUnsupported("string operand", code=Reason.BUILTIN_ARG_SHAPE)
 
 
 def _freeze_sig(sig):

@@ -22,7 +22,16 @@ across batches.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import numpy as np
 
@@ -241,6 +250,69 @@ def _encode_token_table_native(
     vnum[rows_idx, cols_idx] = flat_num[src]
     return TokenTable(
         spath, idx0, idx1, kind, vid, vnum, n_tokens, overflow.astype(bool)
+    )
+
+
+def mask_token_table(
+    table: TokenTable,
+    keep_id_fn: Callable[[int], bool],
+    lo: int = 32,
+) -> Tuple[TokenTable, int]:
+    """Drop tokens whose schema-path vocab id fails `keep_id_fn`
+    (statically-dead columns per the IR liveness analysis), compacting
+    survivors to the front of each row and re-bucketing L. Returns the
+    filtered table plus the number of token slots dropped.
+
+    `overflow` is preserved from the input table, never recomputed: an
+    overflowed row was truncated at the ORIGINAL L and may have lost
+    live tokens, so it must keep routing to the interpreter regardless
+    of how small it looks after filtering. `n_tokens` becomes the kept
+    count (the filtered table's true occupancy).
+    """
+    sp = table.spath
+    uniq = np.unique(sp)
+    keep_ids = np.array(
+        [int(p) for p in uniq if p >= 0 and keep_id_fn(int(p))],
+        dtype=np.int32,
+    )
+    keep = np.isin(sp, keep_ids)
+    skipped = int((sp >= 0).sum() - keep.sum())
+    if skipped == 0:
+        return table, 0
+    N = sp.shape[0]
+    kept = keep.sum(axis=1).astype(np.int64)
+    L = _bucket(int(max(kept.max(initial=0), 1)), lo=lo)
+    rows_idx, src_cols = np.nonzero(keep)  # row-major: order preserved
+    starts = np.concatenate([[0], np.cumsum(kept)[:-1]]) if N else (
+        np.zeros((0,), np.int64)
+    )
+    cols_idx = np.arange(int(kept.sum()), dtype=np.int64) - np.repeat(
+        starts, kept
+    )
+    spath = np.full((N, L), -1, np.int32)
+    idx0 = np.full((N, L), -1, np.int32)
+    idx1 = np.full((N, L), -1, np.int32)
+    kind = np.full((N, L), -1, np.int32)
+    vid = np.full((N, L), -1, np.int32)
+    vnum = np.zeros((N, L), np.float32)
+    spath[rows_idx, cols_idx] = sp[rows_idx, src_cols]
+    idx0[rows_idx, cols_idx] = table.idx0[rows_idx, src_cols]
+    idx1[rows_idx, cols_idx] = table.idx1[rows_idx, src_cols]
+    kind[rows_idx, cols_idx] = table.kind[rows_idx, src_cols]
+    vid[rows_idx, cols_idx] = table.vid[rows_idx, src_cols]
+    vnum[rows_idx, cols_idx] = table.vnum[rows_idx, src_cols]
+    return (
+        TokenTable(
+            spath,
+            idx0,
+            idx1,
+            kind,
+            vid,
+            vnum,
+            kept.astype(np.int32),
+            table.overflow.copy(),
+        ),
+        skipped,
     )
 
 

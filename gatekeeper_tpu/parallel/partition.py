@@ -903,6 +903,16 @@ class PartitionDispatcher:
             "partitions_touched": self.touched_stats(),
             "partitions": [],
         }
+        # IR liveness plane (docs/analysis.md §IR analysis): how many
+        # provably-dead token slots the feature-liveness mask has
+        # dropped from batch encodes on this replica
+        driver = getattr(self.client, "_driver", None)
+        live_fn = getattr(driver, "liveness_stats", None)
+        if live_fn is not None:
+            try:
+                doc["liveness"] = live_fn()
+            except Exception:
+                pass
         if self.replica:
             doc["replica"] = self.replica
         if plan is not None:

@@ -276,6 +276,7 @@ class MicroBatcher:
     def _note_decisions(
         self, batch, route: str, rows_dispatched=None, rows_total=None,
         extdata_fetches: Optional[int] = None, per_request=None,
+        columns_skipped_static: Optional[int] = None,
     ) -> None:
         """Stash dispatch facts for every traced member request. Batch-
         shared facts (route, rows, fetches, device share) apply to all;
@@ -295,6 +296,10 @@ class MicroBatcher:
             )
         if extdata_fetches is not None:
             base["extdata_fetches"] = extdata_fetches
+        if columns_skipped_static is not None:
+            # dead token slots the IR liveness mask dropped from this
+            # batch's encode (docs/analysis.md §IR analysis)
+            base["columns_skipped_static"] = columns_skipped_static
         if dev is not None and batch:
             # the batch's measured device window split evenly across
             # members — the request-level share of what the constraint-
@@ -314,6 +319,13 @@ class MicroBatcher:
         ed = getattr(self.client, "external_data", None) if self.client \
             else None
         return int(getattr(ed, "fetch_count", 0) or 0)
+
+    def _liveness_skipped_count(self) -> int:
+        """Driver-cumulative count of provably-dead token slots the IR
+        feature-liveness mask dropped before padding (analysis/ir.py);
+        dispatch sites report the per-batch delta as a decision fact."""
+        drv = getattr(self.client, "_driver", None) if self.client else None
+        return int(getattr(drv, "columns_skipped_static", 0) or 0)
 
     def _shed(self, fut: Future, exc: Exception, reason: str,
               ctx=None, sub_wall: Optional[float] = None) -> None:
@@ -475,6 +487,7 @@ class MicroBatcher:
             self._dispatch_host(batch, reviews, wall0, t0, route="degraded")
             return
         fetch0 = self._extdata_fetch_count()
+        skip0 = self._liveness_skipped_count()
         try:
             fire("webhook.batch_dispatch")
             all_responses = self.client.review_many(reviews)
@@ -509,6 +522,9 @@ class MicroBatcher:
             batch, self._driver_route(len(reviews)),
             rows_dispatched=rows, rows_total=rows,
             extdata_fetches=self._extdata_fetch_count() - fetch0,
+            columns_skipped_static=(
+                self._liveness_skipped_count() - skip0
+            ),
         )
         for (_, fut, _, _, _), responses in zip(batch, all_responses):
             resp = responses.by_target.get(self.target)
@@ -564,6 +580,7 @@ class MicroBatcher:
             part.run_probes(reviews)
             return
         fetch0 = self._extdata_fetch_count()
+        skip0 = self._liveness_skipped_count()
         prefetch = getattr(client, "prefetch_external", None)
         if prefetch is not None:
             # one deduped external-data fetch epoch for the whole batch
@@ -759,6 +776,9 @@ class MicroBatcher:
                 batch, self._driver_route(n_rev),
                 extdata_fetches=self._extdata_fetch_count() - fetch0,
                 per_request=per_request,
+                columns_skipped_static=(
+                    self._liveness_skipped_count() - skip0
+                ),
             )
         for i, (_, fut, _, _, _) in enumerate(batch):
             if i in errors:

@@ -389,7 +389,7 @@ def test_all_gate_over_shipped_policies(capsys):
     rc = run(["all", DEPLOY])
     out = capsys.readouterr().out
     assert rc == 0, out
-    for plane in ("templates", "mutators", "providers", "corpus"):
+    for plane in ("templates", "mutators", "providers", "corpus", "ir"):
         assert f"== {plane} ==" in out
     assert "== gate ==" in out
 

@@ -1,0 +1,148 @@
+"""jax.jit static-argument AST lint (analysis/jitlint.py, GK-J0xx).
+
+The repo gate: every jit call site in the package must keep its
+static_argnames/static_argnums in sync with the wrapped function's
+signature, and no static parameter may default to an unhashable
+literal. Both failure modes surface only at trace time on device;
+this keeps them a tier-1 CPU failure instead.
+"""
+
+import os
+
+from gatekeeper_tpu.analysis.jitlint import (
+    lint_paths,
+    lint_source,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "gatekeeper_tpu")
+
+
+def test_package_jit_sites_are_clean():
+    findings = lint_paths([PKG])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_drifted_static_argnames_flagged():
+    src = """
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnames=("g_max",))
+def run(tok, consts):
+    return tok
+"""
+    codes = [f.code for f in lint_source(src)]
+    assert codes == ["GK-J001"]
+
+
+def test_matching_static_argnames_clean():
+    src = """
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnames=("g_max",))
+def run(tok, consts, g_max=8):
+    return tok
+"""
+    assert lint_source(src) == []
+
+
+def test_call_form_resolves_local_def():
+    src = """
+import jax
+
+def dispatch():
+    def run(tok, mode):
+        return tok
+    return jax.jit(run, static_argnames=("mode", "gone"))
+"""
+    findings = lint_source(src)
+    assert [f.code for f in findings] == ["GK-J001"]
+    assert "'gone'" in findings[0].message
+
+
+def test_static_argnums_out_of_range():
+    src = """
+import jax
+
+def f(a, b):
+    return a
+
+fn = jax.jit(f, static_argnums=(2,))
+"""
+    assert [f.code for f in lint_source(src)] == ["GK-J002"]
+
+
+def test_static_argnums_in_range_and_vararg_tolerated():
+    src = """
+import jax
+
+def f(a, b):
+    return a
+
+def g(*rows):
+    return rows
+
+f1 = jax.jit(f, static_argnums=(1,))
+g1 = jax.jit(g, static_argnums=(3,))
+"""
+    assert lint_source(src) == []
+
+
+def test_unhashable_static_default_flagged():
+    src = """
+import jax
+
+def f(tok, layout=[]):
+    return tok
+
+fn = jax.jit(f, static_argnames=("layout",))
+"""
+    findings = lint_source(src)
+    assert [f.code for f in findings] == ["GK-J003"]
+    assert "list" in findings[0].message
+
+
+def test_unhashable_default_via_static_argnums():
+    src = """
+import jax
+
+def f(tok, layout={}):
+    return tok
+
+fn = jax.jit(f, static_argnums=(1,))
+"""
+    assert [f.code for f in lint_source(src)] == ["GK-J003"]
+
+
+def test_runtime_computed_names_skipped():
+    """Non-literal static_argnames can't be proven; no finding."""
+    src = """
+import jax
+
+NAMES = ("mode",)
+
+def f(tok, mode):
+    return tok
+
+fn = jax.jit(f, static_argnames=NAMES)
+"""
+    assert lint_source(src) == []
+
+
+def test_unresolvable_target_skipped():
+    src = """
+import jax
+from somewhere import imported_fn
+
+fn = jax.jit(imported_fn, static_argnames=("whatever",))
+"""
+    assert lint_source(src) == []
+
+
+def test_syntax_error_reported_not_raised(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    findings = lint_paths([str(tmp_path)])
+    assert [f.code for f in findings] == ["GK-J000"]

@@ -1,0 +1,185 @@
+"""Liveness-masking parity battery (ISSUE 16 acceptance).
+
+The IR plane's serving artifact is the feature-liveness mask: the
+driver drops statically-dead token columns from EPHEMERAL review
+batches before padding (flatten/encoder.py mask_token_table, gated by
+tpudriver._liveness_keep_fn). The contract is byte-identical merged
+verdicts with masking on vs off over the shipped corpus — while
+actually skipping columns (a vacuous proof that never drops anything
+would also "pass").
+"""
+
+import os
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEPLOY = os.path.join(REPO, "deploy", "policies")
+TARGET = "admission.k8s.gatekeeper.sh"
+
+
+def _shipped_docs():
+    docs = []
+    for root, _dirs, files in os.walk(DEPLOY):
+        for fn in sorted(files):
+            if fn.endswith((".yaml", ".yml")):
+                with open(os.path.join(root, fn)) as f:
+                    docs.extend(
+                        d for d in yaml.safe_load_all(f)
+                        if isinstance(d, dict)
+                    )
+    return docs
+
+
+def _client(liveness_enabled):
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    driver = TpuDriver()
+    driver.liveness_enabled = liveness_enabled
+    client = Backend(driver).new_client(K8sValidationTarget())
+    docs = _shipped_docs()
+    kinds = set()
+    for d in docs:
+        if d.get("kind") != "ConstraintTemplate":
+            continue
+        targets = (d.get("spec") or {}).get("targets") or []
+        if targets and targets[0].get("target") == TARGET:
+            client.add_template(d)
+            kinds.add(d["spec"]["crd"]["spec"]["names"]["kind"])
+    for d in docs:
+        if str(d.get("apiVersion", "")).startswith(
+            "constraints.gatekeeper.sh"
+        ) and d.get("kind") in kinds:
+            client.add_constraint(d)
+    return client, driver
+
+
+def _pod(name, image, annotations=None, memory=None):
+    spec = {"containers": [{"name": "main", "image": image}]}
+    if memory:
+        spec["containers"][0]["resources"] = {
+            "limits": {"memory": memory}
+        }
+    meta = {"name": name, "namespace": "default"}
+    if annotations:
+        meta["annotations"] = annotations
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": meta,
+        "spec": spec,
+    }
+
+
+OWNER = {"owner": "team-x", "contact": "x@example.com"}
+
+REVIEWS = [
+    # violates GTNoLatestTag + GTRequiredAnnotations
+    _pod("latest-noowner", "nginx:latest"),
+    # violates GTDeniedImageRegistries (docker.io default registry)
+    _pod("dockerhub", "library/redis:7", annotations=OWNER),
+    # violates GTMemoryLimitCeiling
+    _pod("fat", "registry.corp/app:1.2", annotations=OWNER,
+         memory="32Gi"),
+    # clean
+    _pod("clean", "registry.corp/app:1.2", annotations=OWNER,
+         memory="1Gi"),
+    # pathological extras: lots of dead columns (labels, node fields)
+    {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "wide",
+            "namespace": "default",
+            "annotations": OWNER,
+            "labels": {f"l{i}": f"v{i}" for i in range(12)},
+        },
+        "spec": {
+            "containers": [
+                {"name": "main", "image": "registry.corp/app:1.2"}
+            ],
+            "nodeSelector": {"pool": "a"},
+            "tolerations": [{"key": "k", "operator": "Exists"}],
+        },
+    },
+]
+
+
+def _verdicts(client):
+    out = []
+    for obj in REVIEWS:
+        rows = sorted(
+            (
+                r.constraint["metadata"]["name"],
+                r.msg,
+                r.enforcement_action,
+            )
+            for r in client.review(obj).results()
+        )
+        out.append((obj["metadata"]["name"], rows))
+    return out
+
+
+def test_masked_and_unmasked_verdicts_byte_identical():
+    client_on, drv_on = _client(True)
+    client_off, drv_off = _client(False)
+
+    on = _verdicts(client_on)
+    off = _verdicts(client_off)
+    assert on == off
+
+    # the battery must not be vacuous: violations actually fired...
+    assert any(rows for _name, rows in on)
+    # ...and the masked driver actually dropped dead columns while the
+    # unmasked driver encoded everything
+    assert drv_on.columns_skipped_static > 0
+    assert drv_on.liveness_batches > 0
+    assert drv_off.columns_skipped_static == 0
+
+
+def test_liveness_stats_surface():
+    client, drv = _client(True)
+    _verdicts(client)
+    stats = drv.liveness_stats()
+    assert stats["enabled"] is True
+    assert stats["columns_skipped_static"] > 0
+    assert stats["liveness_batches"] > 0
+
+
+def test_driver_ir_report_over_live_constraint_set():
+    client, drv = _client(True)
+    _verdicts(client)
+    rep = drv.ir_report(TARGET)
+    live = rep.liveness
+    assert live["keep_all"] is False
+    assert live["programs"] == live["maskable"] > 0
+    assert 0 < live["live_patterns"] < live["patterns_total"]
+    # fused taxonomy covers every compiled constraint subject
+    assert rep.fused
+    assert all(
+        v in ("exact", "screen") or v.startswith("interpreter:")
+        for v in rep.fused.values()
+    )
+    # cached per constraint generation: same object until churn
+    assert drv.ir_report(TARGET) is rep
+
+
+def test_kill_switch_env(monkeypatch):
+    monkeypatch.setenv("GATEKEEPER_TPU_NO_STATIC_LIVENESS", "1")
+    from gatekeeper_tpu.constraint import TpuDriver
+
+    drv = TpuDriver()
+    assert drv.liveness_enabled is False
+    assert drv.liveness_stats()["enabled"] is False
+
+
+def test_dispatch_stats_report_columns_skipped():
+    client, drv = _client(True)
+    for obj in REVIEWS:
+        client.review(obj)
+    assert "columns_skipped_static" in drv.stats
+    assert drv.stats["columns_skipped_static"] >= 0
+    assert drv.columns_skipped_static > 0

@@ -1,0 +1,278 @@
+"""Partial-set compile edge cases (`engine/symbolic.py:_iterate_partial_set`).
+
+Same-module partial-set rules (`bad[x]` refs from a violation body) are
+compiled by inlining each clause's body with caller-side constants
+pre-bound. These tests pin the edge cases: statically-empty unification
+(field-set or scalar mismatch), same-module variable shadowing across
+the call boundary, multi-clause union, and the stable `Reason` codes
+raised for unsupported operand shapes — enum identity, not message
+string-matching.
+"""
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.engine.symbolic import CompileUnsupported, Reason
+
+from test_template_compile import compile_and_count, ctr, oracle_count, pod
+
+PODS = [
+    pod(containers=[ctr("a", image="nginx")]),
+    pod(containers=[ctr("b", image="redis")]),
+    pod(containers=[ctr("c1", image="nginx"), ctr("c2", image="nginx")]),
+    pod(containers=[]),
+    pod(containers=[ctr("d", image="nginx"), ctr("e", image="redis")]),
+]
+
+
+def agree(src, params=None, reviews=PODS):
+    """Compiled counts must match the interpreter; returns the counts."""
+    params = params or {}
+    want, _, _ = oracle_count(src, params, reviews)
+    got = compile_and_count(src, params, reviews)
+    assert np.array_equal(got, want), f"compiled {got} != oracle {want}"
+    return got
+
+
+def code_of(excinfo) -> Reason:
+    return excinfo.value.code
+
+
+def test_same_module_partial_set_matches_interpreter():
+    counts = agree(
+        """
+package t
+
+bad[name] {
+    c := input.review.object.spec.containers[_]
+    c.image == "nginx"
+    name := c.name
+}
+
+violation[{"msg": msg}] {
+    bad[name]
+    msg := name
+}
+"""
+    )
+    assert counts.tolist() == [1, 0, 2, 0, 1]
+
+
+def test_multi_clause_partial_set_unions_across_clauses():
+    counts = agree(
+        """
+package t
+
+bad[name] {
+    c := input.review.object.spec.containers[_]
+    c.image == "nginx"
+    name := c.name
+}
+
+bad[name] {
+    c := input.review.object.spec.containers[_]
+    c.image == "redis"
+    name := c.name
+}
+
+violation[{"msg": msg}] {
+    bad[name]
+    msg := name
+}
+"""
+    )
+    assert counts.tolist() == [1, 1, 2, 0, 2]
+
+
+def test_object_literal_operand_unifies_with_head():
+    # the containerlimits `general_violation[{"msg": ..., "field": ...}]`
+    # pattern: caller-side scalar pre-selects the matching clause.
+    agree(
+        """
+package t
+
+item[{"msg": m, "field": "containers"}] {
+    c := input.review.object.spec.containers[_]
+    c.image == "nginx"
+    m := c.name
+}
+
+item[{"msg": m, "field": "volumes"}] {
+    c := input.review.object.spec.containers[_]
+    c.image == "redis"
+    m := c.name
+}
+
+violation[{"msg": msg}] {
+    item[{"msg": msg, "field": "containers"}]
+}
+"""
+    )
+
+
+def test_scalar_mismatch_makes_the_set_statically_empty():
+    # no clause's "field" scalar matches the caller's: every clause
+    # unifies to the empty set at compile time, so the rule never fires.
+    counts = agree(
+        """
+package t
+
+item[{"msg": m, "field": "containers"}] {
+    c := input.review.object.spec.containers[_]
+    m := c.name
+}
+
+violation[{"msg": msg}] {
+    item[{"msg": msg, "field": "volumes"}]
+}
+"""
+    )
+    assert counts.tolist() == [0, 0, 0, 0, 0]
+
+
+def test_field_subset_operand_matches_interpreter():
+    # object patterns are SUBSET matches (every caller field must unify,
+    # extra head fields are ignored) — the interpreter's `_bind_pattern`
+    # semantics, which the compiled path must reproduce.
+    counts = agree(
+        """
+package t
+
+item[{"msg": m, "field": "containers"}] {
+    c := input.review.object.spec.containers[_]
+    m := c.name
+}
+
+violation[{"msg": msg}] {
+    item[{"msg": msg}]
+}
+"""
+    )
+    assert counts.tolist() == [1, 1, 2, 0, 2]
+
+
+def test_caller_field_missing_from_head_is_statically_empty():
+    counts = agree(
+        """
+package t
+
+item[{"msg": m, "field": "containers"}] {
+    c := input.review.object.spec.containers[_]
+    m := c.name
+}
+
+violation[{"msg": msg}] {
+    item[{"msg": msg, "severity": "high"}]
+}
+"""
+    )
+    assert counts.tolist() == [0, 0, 0, 0, 0]
+
+
+def test_caller_variable_shadowing_is_isolated():
+    # the clause body binds `c` internally; the caller binds its own `c`
+    # from the head value. The clause runs in a fresh environment, so
+    # the names never collide.
+    counts = agree(
+        """
+package t
+
+bad[name] {
+    c := input.review.object.spec.containers[_]
+    c.image == "nginx"
+    name := c.name
+}
+
+violation[{"msg": msg}] {
+    bad[c]
+    msg := c
+}
+"""
+    )
+    assert counts.tolist() == [1, 0, 2, 0, 1]
+
+
+# -- unsupported operand shapes → stable Reason codes -----------------------
+
+
+def compile_expect_raise(src):
+    with pytest.raises(CompileUnsupported) as ei:
+        compile_and_count(src, {}, PODS[:1])
+    return ei
+
+
+def test_array_operand_raises_partial_set_code():
+    ei = compile_expect_raise(
+        """
+package t
+
+bad[name] {
+    c := input.review.object.spec.containers[_]
+    name := c.name
+}
+
+violation[{"msg": msg}] {
+    bad[[x]]
+    msg := x
+}
+"""
+    )
+    assert code_of(ei) is Reason.PARTIAL_SET
+
+
+def test_scalar_membership_operand_raises_partial_set_code():
+    ei = compile_expect_raise(
+        """
+package t
+
+bad[name] {
+    c := input.review.object.spec.containers[_]
+    name := c.name
+}
+
+violation[{"msg": msg}] {
+    bad["a"]
+    msg := "saw a"
+}
+"""
+    )
+    assert code_of(ei) is Reason.PARTIAL_SET
+
+
+def test_non_const_pattern_field_raises_partial_set_code():
+    # object-literal operand whose field value is token-space (c.image)
+    # rather than a compile-time constant.
+    ei = compile_expect_raise(
+        """
+package t
+
+item[{"msg": m, "field": "containers"}] {
+    m := "x"
+}
+
+violation[{"msg": msg}] {
+    c := input.review.object.spec.containers[_]
+    item[{"msg": msg, "field": c.image}]
+}
+"""
+    )
+    assert code_of(ei) is Reason.PARTIAL_SET
+
+
+def test_bare_partial_set_ref_raises_rule_ref_code():
+    ei = compile_expect_raise(
+        """
+package t
+
+bad[name] {
+    c := input.review.object.spec.containers[_]
+    name := c.name
+}
+
+violation[{"msg": msg}] {
+    count(bad) > 0
+    msg := "any"
+}
+"""
+    )
+    assert code_of(ei) is Reason.RULE_REF
