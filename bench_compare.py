@@ -53,6 +53,9 @@ WATCHED: Dict[str, int] = {
     "http_5xx": +1,
     "throughput_rps": -1,
     "slo_attainment": -1,
+    # live SLO plane (obs/slo.py): higher saturation at the same load
+    # = less headroom for the autoscaler (the --slo lane's headline)
+    "saturation": +1,
     "cache_hit_rate": -1,
     # corpus static analysis (ISSUE 15): fewer statically-excluded
     # dead rows = the corpus pass stopped proving the seeded dead

@@ -561,6 +561,134 @@ def run_chaos_bench(n_requests=3000, n_constraints=20, err=sys.stderr):
     }
 
 
+def run_slo_bench(n_requests=1800, n_constraints=20, err=sys.stderr):
+    """The `--slo` replay (docs/observability.md §SLO & saturation):
+    the streaming SLO engine watching a clean → device-faulted →
+    recovered cycle through the decision-log seam. Reports per-phase
+    live attainment/burn/saturation, the breach count (the fault phase
+    must fire exactly one slo_breach flight record — hysteresis), and
+    the autoscaler headline (saturation, headroom) after recovery.
+    Short burn windows scale the 1 min/15 min production policy down
+    to bench wall-clock; the arithmetic is identical."""
+    from gatekeeper_tpu.constraint import TpuDriver
+    from gatekeeper_tpu.faults import FAULTS, CircuitBreaker
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.obs import (
+        DecisionLog,
+        FlightRecorder,
+        SloEngine,
+        SloTarget,
+    )
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    from gatekeeper_tpu.obs import Tracer
+
+    metrics = MetricsRegistry()
+    client = build_chaos_client(TpuDriver(), n_constraints)
+    tracer = Tracer(max_traces=128)
+    decisions = DecisionLog(metrics=metrics, replica="slo-bench")
+    recorder = FlightRecorder(
+        tracer=tracer, metrics=metrics, decisions=decisions,
+        replica="slo-bench",
+    )
+    # the deadline leaves room for replay queueing at this
+    # concurrency: the clean phase must attain so the fault phase's
+    # burn (error verdicts) is what crosses the threshold
+    target = SloTarget(
+        objective=0.99,
+        deadline_s=1.5,
+        fast_window_s=2.0,
+        slow_window_s=10.0,
+    )
+    slo = SloEngine(
+        target=target, metrics=metrics, recorder=recorder,
+        replica="slo-bench",
+    )
+    decisions.slo = slo
+    # deliberately NO circuit breaker: the chaos lane shows the
+    # breaker absorbing this fault (host-oracle degraded mode keeps
+    # the SLO); this lane measures the SLO plane itself, so the fault
+    # must be allowed to fail requests and burn budget
+    batcher = MicroBatcher(
+        client, TARGET, window_ms=2.0, metrics=metrics,
+        max_queue=512, decisions=decisions,
+    )
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=10, metrics=metrics,
+        fail_policy="open", decision_log=decisions, tracer=tracer,
+    )
+    n_sub = max(300, n_requests // 6)
+    out = []
+    batcher.start()
+    try:
+        _warm_route(client)
+        replay(handler, [make_request(i) for i in range(512)], 128)
+        # warmup traffic out of the windows: the phases below are the
+        # measurement
+        slo.reset_windows()
+
+        def run_phase(name):
+            r = replay(
+                handler, [make_request(i) for i in range(n_sub)], 64
+            )
+            snap = slo.snapshot()
+            plane = snap["planes"].get("validation") or {}
+            r.update(
+                phase=name,
+                slo_attainment=plane.get("attainment_fast"),
+                burn_rate_fast=plane.get("burn_rate_fast"),
+                saturation=snap["utilization"]["saturation"],
+                burning=snap["burning"],
+                breaches=snap["breaches"],
+            )
+            out.append(r)
+            print(f"slo phase: {r}", file=err)
+
+        run_phase("clean")
+        # the degradation ladder absorbs a lone batch_dispatch fault
+        # (the host-oracle rung still answers within deadline), so the
+        # SLO stays green — correct, but this lane measures the SLO
+        # plane itself. Fail BOTH rungs, like smoke_scenario's fault
+        # phase: requests resolve EvaluationUnavailable ("unavailable"
+        # verdict = shed), which the engine counts against the budget.
+        FAULTS.arm("webhook.batch_dispatch", mode="error")
+        FAULTS.arm("webhook.host_review", mode="error")
+        run_phase("device_fault")
+        FAULTS.reset()
+        # let the fault-phase errors age out of the fast window so the
+        # recovered phase measures the recovered system (and the
+        # hysteresis latch clears below clear_threshold)
+        time.sleep(target.fast_window_s + 0.2)
+        run_phase("recovered")
+    finally:
+        batcher.stop()
+        FAULTS.reset()
+        recorder.flush(timeout=1.0)
+        recorder.stop()
+    snap = slo.snapshot()
+    plane = snap["planes"].get("validation") or {}
+    util = snap["utilization"]
+    return {
+        "constraints": n_constraints,
+        "target": target.to_dict(),
+        "phases": out,
+        "slo_attainment": plane.get("attainment_slow"),
+        "burn_rate_fast": plane.get("burn_rate_fast"),
+        "saturation": util["saturation"],
+        "headroom_rps": util["estimated_headroom_rps"],
+        "burning": snap["burning"],
+        "breaches": snap["breaches"],
+        "error_budget_remaining": snap["error_budget_remaining"],
+        "breach_records": [
+            r["trigger"] for r in recorder.records()
+            if r.get("trigger") == "slo_breach"
+        ],
+    }
+
+
 def build_partition_client(driver, n_constraints):
     """Policy load for the --partitions lane: ONE template, n
     constraints named w000..wNNN (zero-padded so the driver's sorted
@@ -2064,6 +2192,13 @@ def _summarize(mode, res):
             head["http_5xx"] = res.get("http_5xx")
             head["compiles"] = res.get("compiles")
             head["swaps"] = res.get("swaps")
+        elif mode == "slo":
+            head["phases"] = len(res.get("phases") or [])
+            for k in ("slo_attainment", "saturation", "burn_rate_fast",
+                      "headroom_rps", "breaches", "burning",
+                      "error_budget_remaining"):
+                if k in res:
+                    head[k] = res[k]
         elif mode == "mutate":
             replays = res.get("replays") or []
             if replays:
@@ -2217,6 +2352,13 @@ if __name__ == "__main__":
         res = run_mutate_bench(n_req, n_mut)
         print(json.dumps(res))
         print(_summarize("mutate", res))
+    elif "--slo" in sys.argv:
+        pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n_req = int(pos[0]) if pos else 1_800
+        n_con = int(pos[1]) if len(pos) > 1 else 20
+        res = run_slo_bench(n_req, n_con)
+        print(json.dumps(res))
+        print(_summarize("slo", res))
     else:
         n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
         n_con = int(sys.argv[2]) if len(sys.argv) > 2 else 50

@@ -122,9 +122,20 @@ class Runner:
         # not-ready while the webhook listener still accepts, so the
         # LB/kubelet routes away before connections start failing
         drain_grace_s: float = 0.0,
+        # live SLO & saturation plane (docs/observability.md §SLO &
+        # saturation): SloTarget the streaming engine judges every
+        # admission against. None = defaults (99% within the handler's
+        # own deadline slack, 60s/900s burn windows).
+        slo_target=None,
     ):
         from ..logs import null_logger
-        from ..obs import CostAttributor, DecisionLog, FlightRecorder, Tracer
+        from ..obs import (
+            CostAttributor,
+            DecisionLog,
+            FlightRecorder,
+            SloEngine,
+            Tracer,
+        )
 
         self.tracer = tracer if tracer is not None else Tracer()
 
@@ -169,6 +180,16 @@ class Runner:
             decisions=self.decisions,
             replica=pod_name,
         )
+        # streaming SLO engine, fed through the decision-log seam so
+        # every plane's verdicts/latencies/sheds stream in without any
+        # handler changes; breaches fire slo_breach flight records
+        self.slo = SloEngine(
+            target=slo_target,
+            metrics=metrics,
+            recorder=self.recorder,
+            replica=pod_name,
+        )
+        self.decisions.slo = self.slo
         self.excluder = Excluder()
         self.tracker = ReadinessTracker()
         self.switch = ControllerSwitch()
@@ -947,6 +968,11 @@ class Runner:
                         "flightrecords": runner.recorder.snapshot(),
                         "decisions": runner.decisions.snapshot(),
                     }
+                    # live SLO headline — the `saturation`/`burning`
+                    # fields are the autoscaler contract (full
+                    # breakdown at /debug/slo); docs/observability.md
+                    # §SLO & saturation
+                    stats["slo"] = runner.slo.autoscaler()
                     # corpus analysis headline (docs/analysis.md
                     # §Corpus analysis): diagnostic counts + the
                     # dead/prunable/shadowed rollup; recompute is
@@ -1065,6 +1091,17 @@ class Runner:
 
                     payload = export_decisions(
                         runner.decisions, self.path
+                    ).encode()
+                    self.send_response(200)
+                elif self.path.split("?")[0] == "/debug/slo":
+                    # live SLO plane: per-plane/per-tenant attainment,
+                    # burn rates, saturation/headroom — ?plane=/
+                    # ?tenants=0 (docs/observability.md §SLO &
+                    # saturation)
+                    from ..obs.slo import export_slo
+
+                    payload = export_slo(
+                        runner.slo, self.path
                     ).encode()
                     self.send_response(200)
                 elif self.path == "/healthz":

@@ -410,6 +410,7 @@ def serve_metrics(
     recorder=None,
     decisions=None,
     partitions=None,
+    slo=None,
 ) -> ThreadingHTTPServer:
     """Serve /metrics (Prometheus text) on a background thread; returns
     the server (server_address[1] carries the bound port). The reference
@@ -418,7 +419,9 @@ def serve_metrics(
     With a tracer, /debug/traces serves the trace ring (?trace_id= /
     ?limit= / ?format=otlp — docs/observability.md); an attributor adds
     /debug/costs (the top-K cost table), a flight recorder adds
-    /debug/flightrecords, a decision log adds /debug/decisions, and a
+    /debug/flightrecords, a decision log adds /debug/decisions, an SLO
+    engine adds /debug/slo (live attainment/burn/saturation,
+    docs/observability.md §SLO & saturation), and a
     partition dispatcher adds /debug/partitions (the live cost/locality
     plan composition) and /debug/programs (the compile plane: per-
     partition sub-program signatures + program-store stats,
@@ -449,6 +452,11 @@ def serve_metrics(
                     if "format=ndjson" in self.path
                     else "application/json"
                 )
+            elif slo is not None and route == "/debug/slo":
+                from ..obs.slo import export_slo
+
+                payload = export_slo(slo, self.path).encode()
+                ctype = "application/json"
             elif partitions is not None and route == "/debug/partitions":
                 payload = json.dumps(partitions.plan_table()).encode()
                 ctype = "application/json"

@@ -11,6 +11,7 @@ from .decisionlog import (
     check_decision_schema,
 )
 from .flightrecorder import FlightRecorder
+from .slo import QuantileSketch, SloEngine, SloTarget, export_slo
 from .tracer import (
     NOOP_SPAN,
     Span,
@@ -30,7 +31,11 @@ __all__ = [
     "CostAttributor",
     "DecisionLog",
     "FlightRecorder",
+    "QuantileSketch",
+    "SloEngine",
+    "SloTarget",
     "check_decision_schema",
+    "export_slo",
     "Span",
     "SpanContext",
     "Tracer",

@@ -136,8 +136,14 @@ class DecisionLog:
         # bounded on-disk NDJSON spool (one file, rewritten on
         # rotation) — None/"" = memory only
         clock=time.monotonic,
+        # optional SloEngine (obs/slo.py): every record_decision call
+        # feeds it BEFORE sampling/rate-gating, so the streaming SLO
+        # estimator sees the full stream the ring only samples; also
+        # settable post-construction (`log.slo = engine`)
+        slo=None,
     ):
         self.metrics = metrics
+        self.slo = slo
         self.replica = replica
         self.max_records = max(1, int(max_records))
         self.dir = dir if dir is not None else os.environ.get(
@@ -184,6 +190,14 @@ class DecisionLog:
             else:
                 cur.update(facts)
                 self._facts.move_to_end(trace_id)
+        # the batch-apportioned device share doubles as the SLO
+        # engine's cost sample (saturation/headroom EWMA)
+        share = facts.get("device_seconds_share")
+        if share is not None and self.slo is not None:
+            try:
+                self.slo.note_cost(float(share), rows=1)
+            except (TypeError, ValueError):
+                pass
 
     def _pop_facts(self, trace_id: Optional[str]) -> Dict[str, Any]:
         if not trace_id:
@@ -243,6 +257,9 @@ class DecisionLog:
         `decisions_dropped_total`). Never raises: the admission path
         calls this inline and a broken field must cost a record, not a
         request."""
+        self._observe_slo(
+            plane, verdict, duration_ms, deadline_slack_ms, tenant
+        )
         try:
             return self._record(
                 plane, verdict, code, trace_id, duration_ms, tenant,
@@ -250,6 +267,52 @@ class DecisionLog:
             )
         except Exception:
             return None
+
+    def _observe_slo(
+        self, plane, verdict, duration_ms, deadline_slack_ms, tenant,
+    ) -> None:
+        """The live-SLO seam: runs for EVERY decision, before the
+        sampling and rate gates below (the estimator must see the full
+        stream), and stamps the `admission_deadline_slack_seconds`
+        histogram at the same spot that stamps `deadline_slack_ms`
+        into the record. Fully defensive — observability feeds must
+        never cost a request."""
+        try:
+            if self.metrics is not None and deadline_slack_ms is not None:
+                # negative slack (deadline already blown) lands in the
+                # first bucket, which is exactly the bucket to alarm on
+                self.metrics.observe(
+                    "admission_deadline_slack_seconds",
+                    deadline_slack_ms / 1e3,
+                    plane=plane,
+                )
+            slo = self.slo
+            if slo is None:
+                return
+            shed = verdict in ("shed", "unavailable")
+            duration_s = (
+                duration_ms / 1e3 if duration_ms is not None else None
+            )
+            if shed or verdict == "error":
+                ok = False
+            else:
+                # deny IS ok — the SLO is about answering in time, not
+                # admitting. Judge vs the target's own deadline when
+                # configured (the soak contract), else vs the slack the
+                # handler computed from its request timeout.
+                deadline = getattr(slo.target, "deadline_s", None)
+                if deadline is not None and duration_s is not None:
+                    ok = duration_s <= deadline
+                elif deadline_slack_ms is not None:
+                    ok = deadline_slack_ms >= 0.0
+                else:
+                    ok = True
+            slo.observe(
+                plane, ok,
+                duration_s=duration_s, shed=shed, tenant=tenant,
+            )
+        except Exception:
+            pass
 
     def _record(
         self, plane, verdict, code, trace_id, duration_ms, tenant,

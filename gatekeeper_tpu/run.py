@@ -204,6 +204,7 @@ def main(argv=None) -> int:
                 partitions=getattr(
                     runner.webhook, "partitioner", None
                 ),
+                slo=runner.slo,
             )
             log.info(
                 "metrics serving", prometheus_port=args.prometheus_port

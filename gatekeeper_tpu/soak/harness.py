@@ -224,6 +224,7 @@ class _Replica:
         self.attributor = None  # per-constraint device-time accounting
         self.recorder = None  # trip-triggered postmortem capture
         self.decisions = None  # per-admission decision log
+        self.slo = None  # live streaming SLO engine (obs/slo.py)
         self.corpus = None  # corpus static-analysis plane
 
     @property
@@ -327,6 +328,19 @@ class SoakHarness:
             decisions=rep.decisions,
             replica=name,
         )
+        # live SLO engine, judging every admission against the SAME
+        # target the offline reporter scores (the scenario's deadline
+        # contract + any `slo` overrides) — the live_vs_offline soak
+        # check compares the two planes after the run
+        from ..obs import SloEngine
+
+        rep.slo = SloEngine(
+            target=scn.slo_target(),
+            metrics=rep.metrics,
+            recorder=rep.recorder,
+            replica=name,
+        )
+        rep.decisions.slo = rep.slo
         rep.client = Backend(rep.driver).new_client(
             K8sValidationTarget(), AgentActionTarget()
         )
@@ -813,6 +827,15 @@ class SoakHarness:
         dec_recorded = dec_dropped = dec_sampled = dec_ring = 0
         dec_routes: Dict[str, int] = {}
         pt_p50 = pt_max = None  # pruned-dispatch width across replicas
+        # live SLO plane (obs/slo.py): saturation is the WORST replica
+        # (the autoscaler scales on the hottest pod), attainment is
+        # request-weighted across replicas, breaches/burning aggregate
+        slo_sat = None
+        slo_burning = False
+        slo_breaches = 0
+        slo_fast_n = slo_fast_ok = 0.0
+        slo_slow_n = slo_slow_ok = 0.0
+        slo_burn_fast = 0.0
         degraded = 0  # webhook_degraded_dispatch_total across planes
         program_swaps = program_carryforwards = program_compiles = 0
         corpus_recomputes = 0  # corpus-analysis background refreshes
@@ -854,6 +877,28 @@ class SoakHarness:
                 dec_ring += dsnap["retained"]
                 for route, n in dsnap["routes"].items():
                     dec_routes[route] = dec_routes.get(route, 0) + n
+            if rep.slo is not None:
+                auto = rep.slo.autoscaler()
+                s = auto.get("saturation")
+                if s is not None:
+                    slo_sat = s if slo_sat is None else max(slo_sat, s)
+                slo_burning = slo_burning or bool(auto.get("burning"))
+                slo_breaches += int(auto.get("breaches") or 0)
+                ssnap = rep.slo.snapshot()
+                for p in ssnap["planes"].values():
+                    slo_burn_fast = max(
+                        slo_burn_fast, p["burn_rate_fast"]
+                    )
+                    if p["attainment_fast"] is not None:
+                        slo_fast_n += p["requests_fast"]
+                        slo_fast_ok += (
+                            p["attainment_fast"] * p["requests_fast"]
+                        )
+                    if p["attainment_slow"] is not None:
+                        slo_slow_n += p["requests_slow"]
+                        slo_slow_ok += (
+                            p["attainment_slow"] * p["requests_slow"]
+                        )
             # degraded dispatches (breaker-open / all-dead host
             # routing): the ingest_zero_degraded check's evidence —
             # host-rung routing during a background restage does NOT
@@ -924,6 +969,18 @@ class SoakHarness:
             "program_carryforwards_cum": program_carryforwards,
             "program_compiles_cum": program_compiles,
             "corpus_recomputes_cum": corpus_recomputes,
+            # live SLO plane (obs/slo.py)
+            "slo_saturation": slo_sat,
+            "slo_burning": slo_burning,
+            "slo_breaches_cum": slo_breaches,
+            "slo_burn_fast": round(slo_burn_fast, 3),
+            "slo_live_attainment_fast": (
+                slo_fast_ok / slo_fast_n if slo_fast_n else None
+            ),
+            "slo_live_attainment_slow": (
+                slo_slow_ok / slo_slow_n if slo_slow_n else None
+            ),
+            "slo_live_requests_slow": int(slo_slow_n),
         }
 
     def _sampler_loop(self) -> None:
@@ -1006,6 +1063,19 @@ class SoakHarness:
                 "corpus_recomputes": (
                     cur["corpus_recomputes_cum"]
                     - prev["corpus_recomputes_cum"]
+                ),
+                # live SLO plane at this window's close: worst-replica
+                # saturation, live fast-window attainment/burn, any
+                # plane in the burning state, breaches fired this
+                # window (each breach = one slo_breach flight record)
+                "slo_saturation": cur["slo_saturation"],
+                "slo_burning": cur["slo_burning"],
+                "slo_burn_fast": cur["slo_burn_fast"],
+                "slo_live_attainment": (
+                    cur["slo_live_attainment_fast"]
+                ),
+                "slo_breaches": (
+                    cur["slo_breaches_cum"] - prev["slo_breaches_cum"]
                 ),
             })
             prev = cur
@@ -1096,6 +1166,13 @@ class SoakHarness:
         scn = self.scenario
         self.build()
         warm_s = self.warmup()
+        # live SLO windows restart here: warmup traffic (all-good,
+        # closed-loop) would otherwise inflate live attainment over
+        # what the offline reporter bins from the measured run — the
+        # cost EWMA warmup primed is kept
+        for rep in self.replicas:
+            if rep.slo is not None:
+                rep.slo.reset_windows()
         self._log(f"warmup {warm_s:.1f}s; starting open loop "
                   f"@{scn.rps}rps for {scn.duration_s}s")
         self._t0 = time.monotonic()
@@ -1154,6 +1231,7 @@ class SoakHarness:
             split,
             capacity=capacity,
             faults_log=self.faults_log,
+            live_slo=self._live_slo_summary(),
             extra={
                 "events_log": self.events_log,
                 "warmup_seconds": round(warm_s, 1),
@@ -1162,6 +1240,41 @@ class SoakHarness:
             },
         )
         return report
+
+    def _live_slo_summary(self) -> Optional[Dict[str, Any]]:
+        """End-of-run rollup of the per-replica streaming SLO engines:
+        slow-window attainment (request-weighted across replicas) is
+        what the live_vs_offline check compares against the offline
+        reporter; saturation/headroom are the autoscaler signals the
+        capacity model cross-checks."""
+        cum = self._cumulative()
+        if not any(rep.slo is not None for rep in self.replicas):
+            return None
+        headroom = None
+        arrival = 0.0
+        cost = None
+        for rep in self.replicas:
+            if rep.slo is None:
+                continue
+            util = rep.slo.snapshot()["utilization"]
+            arrival += util["arrival_rps"] or 0.0
+            h = util["estimated_headroom_rps"]
+            if h is not None:
+                headroom = h if headroom is None else headroom + h
+            c = util["device_seconds_per_row_ewma"]
+            if c is not None:
+                cost = c if cost is None else max(cost, c)
+        return {
+            "attainment_fast": cum["slo_live_attainment_fast"],
+            "attainment_slow": cum["slo_live_attainment_slow"],
+            "requests_slow": cum["slo_live_requests_slow"],
+            "saturation": cum["slo_saturation"],
+            "burning": cum["slo_burning"],
+            "breaches": cum["slo_breaches_cum"],
+            "arrival_rps": round(arrival, 2),
+            "estimated_headroom_rps": headroom,
+            "device_seconds_per_row_ewma": cost,
+        }
 
     def stop(self) -> None:
         self._stop.set()

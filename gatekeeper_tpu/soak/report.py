@@ -38,6 +38,32 @@ SOAK_SCHEMA_FIELDS = (
 # the LB-flip race on a loaded box)
 KILL_SHED_BOUND = 0.02
 
+# live-vs-offline attainment agreement tolerance: the streaming engine
+# and the offline binner watch the same traffic through different
+# clocks (server-side windows vs client-side schedule), so exact
+# equality is not expected — divergence past this is a measurement bug
+LIVE_OFFLINE_TOL = 0.05
+
+# minimum live slow-window sample count before live-vs-offline
+# agreement is judged (a near-empty window proves nothing)
+LIVE_MIN_SAMPLES = 50
+
+
+def _slo_target(scenario_dict: Dict[str, Any]):
+    """The run's SloTarget (obs/slo.py): the scenario's deadline
+    contract + any `slo` overrides — the SAME object the live
+    per-replica engines judged against, so the offline checks cannot
+    drift from the live plane."""
+    from ..obs.slo import SloTarget
+
+    try:
+        return SloTarget.from_dict(
+            (scenario_dict or {}).get("slo"),
+            deadline_s=(scenario_dict or {}).get("deadline_s"),
+        )
+    except (ValueError, TypeError):
+        return SloTarget()
+
 
 def _pct(sorted_vals: Sequence[float], q: float) -> float:
     if not sorted_vals:
@@ -230,14 +256,24 @@ def build_checks(
     leak: Dict[str, Any],
     transitions: List[Dict[str, Any]],
     windows: List[Dict[str, Any]],
+    target=None,
 ) -> Dict[str, Any]:
+    # degrade/recover thresholds come from the shared SloTarget
+    # (scenario-overridable), not hardcoded here — the live engine and
+    # this reporter judge the same objective
+    if target is None:
+        target = _slo_target({})
     by_name = {p["phase"]: p for p in phases}
     checks: Dict[str, Any] = {}
     fault = by_name.get("fault")
     recovery = by_name.get("recovery")
     if fault and recovery:
-        degraded = (fault["slo_attainment"] or 0.0) < 0.9
-        recovered = (recovery["slo_attainment"] or 0.0) >= 0.95
+        degraded = (
+            (fault["slo_attainment"] or 0.0) < target.degraded_below
+        )
+        recovered = (
+            (recovery["slo_attainment"] or 0.0) >= target.recovered_at
+        )
         trans_in_fault = fault.get("breaker_transitions", 0) > 0 or any(
             t for t in transitions
         )
@@ -295,11 +331,16 @@ def build_report(
     device_time_split: Dict[str, float],
     capacity: Optional[List[Dict[str, Any]]] = None,
     faults_log: Optional[List[Dict[str, Any]]] = None,
+    live_slo: Optional[Dict[str, Any]] = None,
     extra: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Merge generator samples + sampler rows into the soak artifact.
     `window_stats` rows carry server-side per-window observations and
-    are matched to sample windows by index."""
+    are matched to sample windows by index. `live_slo` is the harness's
+    end-of-run rollup of the streaming engines (obs/slo.py) — when
+    present it rides in `slo.live` and is cross-checked against the
+    offline numbers (`live_vs_offline_attainment`,
+    `capacity_live_within_model`)."""
     phase_at = {
         float(e["at"]): e.get("name", "")
         for e in scenario_dict.get("events", [])
@@ -317,7 +358,10 @@ def build_report(
             w.update(window_stats[i])
     phases = aggregate_phases(windows)
     leak = leak_report(windows)
-    checks = build_checks(phases, leak, transitions, windows)
+    target = _slo_target(scenario_dict)
+    checks = build_checks(
+        phases, leak, transitions, windows, target=target
+    )
     total = len(load.samples)
     ok = sum(
         1 for s in load.samples
@@ -334,6 +378,7 @@ def build_report(
         },
         "slo": {
             "deadline_s": scenario_dict["deadline_s"],
+            "target": target.to_dict(),
             "attainment": round(ok / total, 4) if total else None,
             "misses": total - ok,
             "worst_window_p99_ms": max(
@@ -355,6 +400,45 @@ def build_report(
     }
     if capacity is not None:
         report["capacity_model"] = capacity
+    if live_slo is not None:
+        report["slo"]["live"] = live_slo
+        # live-vs-offline agreement: the streaming engine's
+        # slow-window attainment must match what the offline binner
+        # computed from the generator's samples, within tolerance
+        live_att = live_slo.get("attainment_slow")
+        off_att = report["slo"]["attainment"]
+        if (
+            live_att is not None
+            and off_att is not None
+            and (live_slo.get("requests_slow") or 0) >= LIVE_MIN_SAMPLES
+        ):
+            checks["live_vs_offline_attainment"] = {
+                "live": round(live_att, 4),
+                "offline": off_att,
+                "tolerance": LIVE_OFFLINE_TOL,
+                "agree": abs(live_att - off_att) <= LIVE_OFFLINE_TOL,
+            }
+        # headroom sanity vs the offline capacity model: the live
+        # estimate (1 / cost EWMA) is engine-side and the model probes
+        # through the full handler stack, so this is an order-of-
+        # magnitude cross-check, not an equality
+        cost = live_slo.get("device_seconds_per_row_ewma")
+        if capacity and cost:
+            model_max = max(
+                (
+                    row.get("max_rps_at_slo") or 0
+                    for row in capacity
+                ),
+                default=0,
+            )
+            if model_max > 0:
+                live_cap = 1.0 / cost
+                ratio = live_cap / model_max
+                checks["capacity_live_within_model"] = {
+                    "live_capacity_rps": round(live_cap, 1),
+                    "model_max_rps": model_max,
+                    "within": 0.1 <= ratio <= 100.0,
+                }
     if extra:
         report.update(extra)
     return report
@@ -417,6 +501,13 @@ def summarize_soak(res: Dict[str, Any]) -> str:
             for fr in (res.get("flight_records") or [])
         )
         head["leak_flagged"] = (res.get("leak") or {}).get("flagged")
+        # live SLO headline (optional: only runs with streaming
+        # engines attached carry it — older artifacts stay valid)
+        live = (res.get("slo") or {}).get("live") or {}
+        if live:
+            head["saturation"] = live.get("saturation")
+            head["live_attainment"] = live.get("attainment_slow")
+            head["slo_breaches"] = live.get("breaches")
         head["checks"] = res.get("checks")
     except Exception as e:  # the summary must never kill the artifact
         head["error"] = str(e)

@@ -150,7 +150,21 @@ class Scenario:
         }
     )
     capacity: Optional[Dict[str, Any]] = None
+    # SloTarget overrides for the live SLO engine + offline reporter
+    # (obs/slo.py — objective, burn windows/thresholds, the degrade/
+    # recover phase thresholds). deadline_s defaults to the scenario's
+    # own deadline contract; unknown keys are rejected at load time.
+    slo: Optional[Dict[str, Any]] = None
     events: List[ScenarioEvent] = field(default_factory=list)
+
+    def slo_target(self):
+        """The one SloTarget both the live per-replica engines and the
+        offline reporter judge this run against."""
+        from ..obs.slo import SloTarget
+
+        return SloTarget.from_dict(
+            self.slo, deadline_s=self.deadline_s
+        )
 
     def validate(self) -> None:
         if self.duration_s <= 0:
@@ -170,6 +184,8 @@ class Scenario:
                 )
         if sum(self.planes.values()) <= 0:
             raise ValueError("plane weights must sum to > 0")
+        # a typoed slo override must fail the load, not the analysis
+        self.slo_target()
         for ev in self.events:
             if ev.at_s > self.duration_s:
                 raise ValueError(
@@ -201,7 +217,8 @@ class Scenario:
             "name", "duration_s", "rps", "deadline_s", "window_s",
             "seed", "replicas", "tls", "constraints", "external_keys",
             "violating_fraction", "window_ms", "min_device_batch",
-            "partitions", "planes", "breaker", "capacity", "events",
+            "partitions", "planes", "breaker", "capacity", "slo",
+            "events",
         }
         unknown = set(d) - known
         if unknown:
@@ -233,6 +250,7 @@ class Scenario:
             "planes": dict(self.planes),
             "breaker": dict(self.breaker),
             "capacity": self.capacity,
+            "slo": self.slo,
             "events": [e.to_dict() for e in self.events],
         }
 

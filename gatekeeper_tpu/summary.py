@@ -1,8 +1,8 @@
 """The bench SUMMARY line contract, shared by every lane.
 
 Every bench entry point (`bench_webhook.py --ladder/--attribution/
---partitions/--fleet/--chaos/--churn/--external/--mutate/--soak`,
-`bench.py`)
+--partitions/--fleet/--chaos/--churn/--external/--mutate/--soak/
+--slo`, `bench.py`)
 ends its run with one compact driver-parseable line:
 
     SUMMARY: {"mode": "<lane>", ...headline numbers...}
@@ -66,6 +66,13 @@ REQUIRED_FIELDS: Dict[str, tuple] = {
     "mutate": ("p50_ms", "p99_ms", "throughput_rps"),
     "soak": (
         "slo_attainment", "shed_rate", "leak_flagged", "checks",
+    ),
+    # the live SLO plane lane (obs/slo.py): streaming attainment +
+    # burn rate through a fault/recover cycle, plus the autoscaler
+    # signals (saturation up-bad, headroom) bench_compare.py gates
+    "slo": (
+        "slo_attainment", "saturation", "burn_rate_fast",
+        "headroom_rps", "breaches",
     ),
 }
 

@@ -1,0 +1,634 @@
+"""Live SLO & saturation plane tests (ISSUE 17).
+
+The acceptance contract: the streaming quantile sketch holds its
+bounded relative error on adversarial distributions; burn-rate window
+arithmetic is exact under injected clocks (no sleeps); the hysteresis
+latch cannot flap; and the chaos e2e drives an armed device fault
+through a real Runner — fast burn crosses the threshold, EXACTLY one
+`slo_breach` flight record captures, `/readyz` `stats.slo.saturation`
+rises under the fault and recovers after disarm, `/debug/slo` serves
+on BOTH HTTP planes, and the breach record cross-links to decision
+records and traces by shared trace id.
+"""
+
+import json
+import math
+import random
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.obs import QuantileSketch, SloEngine, SloTarget
+from gatekeeper_tpu.obs.slo import export_slo
+
+pytestmark = pytest.mark.slo
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+class FakeClock:
+    def __init__(self, t: float = 10_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class RecorderStub:
+    def __init__(self):
+        self.trips = []
+
+    def trigger(self, reason, **ctx):
+        self.trips.append((reason, ctx))
+
+
+class MetricsStub:
+    def __init__(self):
+        self.gauges = []
+
+    def gauge(self, name, value, **tags):
+        self.gauges.append((name, value, tags))
+
+    def record(self, *a, **k):
+        pass
+
+    def observe(self, *a, **k):
+        pass
+
+
+def engine(clock=None, recorder=None, metrics=None, **target_kw):
+    target_kw.setdefault("objective", 0.9)
+    target_kw.setdefault("min_samples", 10)
+    return SloEngine(
+        target=SloTarget(**target_kw),
+        metrics=metrics,
+        recorder=recorder,
+        replica="t",
+        clock=clock or FakeClock(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# quantile sketch: bounded relative error on adversarial distributions
+
+
+def _exact(vals, q):
+    s = sorted(vals)
+    return s[int(q * (len(s) - 1))]
+
+
+# relative-error contract: geometric midpoint of a GROWTH=1.25 bucket
+# is within sqrt(1.25) - 1 (~11.8%) of any value in the bucket
+_REL_BOUND = math.sqrt(QuantileSketch.GROWTH) - 1 + 1e-9
+
+
+def _adversarial_distributions():
+    rng = random.Random(170817)
+    return {
+        "lognormal": [rng.lognormvariate(-3.0, 1.0) for _ in range(5000)],
+        # two modes three decades apart: a sketch tuned to one mode's
+        # scale must not smear the other
+        "bimodal": [
+            (5e-4 if rng.random() < 0.5 else 2.0)
+            * rng.uniform(0.9, 1.1)
+            for _ in range(4000)
+        ],
+        # heavy tail: p99 lives far from the body
+        "pareto": [1e-3 * rng.paretovariate(1.5) for _ in range(4000)],
+        "uniform_wide": [rng.uniform(1e-4, 10.0) for _ in range(4000)],
+        "constant": [0.05] * 1000,
+    }
+
+
+def test_sketch_bounded_relative_error_adversarial():
+    for name, vals in _adversarial_distributions().items():
+        sk = QuantileSketch()
+        for v in vals:
+            sk.add(v)
+        assert sk.n == len(vals)
+        for q in (0.5, 0.9, 0.99):
+            exact = _exact(vals, q)
+            est = sk.quantile(q)
+            if exact <= QuantileSketch.BASE:
+                # sub-resolution values report BASE (absolute error
+                # <= 100 us), not a relative guarantee
+                assert est == QuantileSketch.BASE
+                continue
+            rel = abs(est - exact) / exact
+            assert rel <= _REL_BOUND, (name, q, exact, est, rel)
+
+
+def test_sketch_merge_equals_single_sketch():
+    """Mergeability is why this sketch over P2: per-window sketches
+    summed into a horizon quantile must equal one big sketch."""
+    rng = random.Random(7)
+    vals = [rng.lognormvariate(-2.0, 1.5) for _ in range(2000)]
+    whole = QuantileSketch()
+    a, b = QuantileSketch(), QuantileSketch()
+    for i, v in enumerate(vals):
+        whole.add(v)
+        (a if i % 2 else b).add(v)
+    merged = QuantileSketch().merge(a).merge(b)
+    assert merged.n == whole.n
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert merged.quantile(q) == whole.quantile(q)
+
+
+def test_sketch_empty_and_clamp():
+    sk = QuantileSketch()
+    assert sk.quantile(0.5) is None
+    sk.add(1e9)  # far above the top edge: clamps, never raises
+    assert sk.quantile(0.5) <= QuantileSketch.BASE * (
+        QuantileSketch.GROWTH ** QuantileSketch.NBUCKETS
+    )
+
+
+# ---------------------------------------------------------------------------
+# SloTarget: the shared objective definition
+
+
+def test_slo_target_rejects_unknown_keys_and_bad_shapes():
+    with pytest.raises(ValueError, match="unknown SloTarget keys"):
+        SloTarget.from_dict({"objectve": 0.99})
+    with pytest.raises(ValueError):
+        SloTarget.from_dict({"objective": 1.5})
+    with pytest.raises(ValueError):
+        SloTarget.from_dict({"fast_window_s": 60.0, "slow_window_s": 30.0})
+    with pytest.raises(ValueError):
+        SloTarget.from_dict({"burn_threshold": 2.0, "clear_threshold": 3.0})
+    with pytest.raises(ValueError):
+        SloTarget.from_dict({"degraded_below": 0.99, "recovered_at": 0.9})
+
+
+def test_slo_target_defaults_merge_and_roundtrip():
+    # harness default: the scenario's deadline contract seeds the
+    # target unless the scenario's slo dict overrides it
+    t = SloTarget.from_dict({}, deadline_s=0.5)
+    assert t.deadline_s == 0.5
+    t = SloTarget.from_dict({"deadline_s": 1.0}, deadline_s=0.5)
+    assert t.deadline_s == 1.0
+    t = SloTarget.from_dict(None)
+    assert t.objective == 0.99
+    assert SloTarget.from_dict(t.to_dict()) == t
+    assert abs(SloTarget(objective=0.9).error_budget - 0.1) < 1e-12
+
+
+# ---------------------------------------------------------------------------
+# burn-rate window arithmetic (injected clocks, no sleeps)
+
+
+def test_burn_rate_arithmetic_and_window_aging():
+    clk = FakeClock()
+    e = engine(clock=clk)  # objective 0.9 -> budget 0.1
+    for _ in range(16):
+        e.observe("validation", ok=True, duration_s=0.01)
+    for _ in range(4):
+        e.observe("validation", ok=False, duration_s=0.2)
+    p = e.snapshot()["planes"]["validation"]
+    assert p["attainment_fast"] == 0.8
+    assert p["burn_rate_fast"] == 2.0  # (4/20) / 0.1
+    assert p["requests_fast"] == 20 and p["misses_fast"] == 4
+
+    # past the fast horizon the fast window is empty but the slow
+    # window still remembers the same 20 decisions
+    clk.advance(66.0)
+    p = e.snapshot()["planes"]["validation"]
+    assert p["requests_fast"] == 0 and p["attainment_fast"] is None
+    assert p["burn_rate_fast"] == 0.0
+    assert p["requests_slow"] == 20 and p["burn_rate_slow"] == 2.0
+
+    # past the slow horizon everything ages out
+    clk.advance(960.0)
+    p = e.snapshot()["planes"]["validation"]
+    assert p["requests_slow"] == 0 and p["attainment_slow"] is None
+
+
+def test_shed_counts_against_budget_deny_does_not():
+    e = engine()
+    for _ in range(10):
+        e.observe("validation", ok=True)          # deny IS ok
+    for _ in range(10):
+        e.observe("validation", ok=False, shed=True)
+    p = e.snapshot()["planes"]["validation"]
+    assert p["attainment_fast"] == 0.5
+    assert p["sheds_fast"] == 10 and p["misses_fast"] == 0
+    assert p["burn_rate_fast"] == 5.0  # (10/20) / 0.1
+
+
+def test_min_samples_gate_an_empty_window_never_pages():
+    rec = RecorderStub()
+    e = engine(recorder=rec, min_samples=10)
+    for _ in range(9):  # 100% miss but below min_samples
+        e.observe("validation", ok=False)
+    assert rec.trips == [] and e.snapshot()["burning"] is False
+
+
+# ---------------------------------------------------------------------------
+# hysteresis: one trigger per entry, no flapping
+
+
+def test_hysteresis_latches_once_and_does_not_flap():
+    clk = FakeClock()
+    rec = RecorderStub()
+    e = engine(clock=clk, recorder=rec)
+    # trip: 10 misses -> burn 10 >= 4 (slow confirming)
+    for _ in range(10):
+        e.observe("validation", ok=False)
+    assert len(rec.trips) == 1
+    reason, ctx = rec.trips[0]
+    assert reason == "slo_breach" and ctx["plane"] == "validation"
+    assert ctx["burn_rate_fast"] >= 4.0 and ctx["requests_fast"] == 10
+    assert ctx["attainment_fast"] == 0.0 and ctx["misses_fast"] == 10
+    # continued burning while latched: no second trigger
+    for _ in range(20):
+        e.observe("validation", ok=False)
+    assert len(rec.trips) == 1 and e.snapshot()["burning"] is True
+    # burn hugging the band between clear (1.0) and trip (4.0)
+    # thresholds must not clear OR re-trip: age the storm out, then
+    # 2 misses per 8 ok -> burn settles at 2.0 (misses first, so the
+    # instantaneous burn never dips to the clear threshold)
+    clk.advance(66.0)
+    for _ in range(2):
+        e.observe("validation", ok=False)
+    for _ in range(8):
+        e.observe("validation", ok=True)
+    assert len(rec.trips) == 1
+    assert e.snapshot()["planes"]["validation"]["burning"] is True
+    # clear: a clean fast window drops burn below clear_threshold
+    clk.advance(66.0)
+    e.observe("validation", ok=True)
+    assert e.snapshot()["burning"] is False
+    # a second full breach fires a SECOND record (fresh window; the
+    # slow window still confirms from history)
+    clk.advance(66.0)
+    for _ in range(10):
+        e.observe("validation", ok=False)
+    assert len(rec.trips) == 2
+    assert e.breaches == 2
+
+
+def test_planes_burn_independently():
+    rec = RecorderStub()
+    e = engine(recorder=rec)
+    for _ in range(10):
+        e.observe("mutation", ok=False)
+    for _ in range(10):
+        e.observe("validation", ok=True)
+    assert [r[1]["plane"] for r in rec.trips] == ["mutation"]
+    snap = e.snapshot()
+    assert snap["planes"]["mutation"]["burning"] is True
+    assert snap["planes"]["validation"]["burning"] is False
+    assert snap["burning"] is True  # any plane burning
+
+
+# ---------------------------------------------------------------------------
+# tenant rings: cardinality capped, overflow counted
+
+
+def test_tenant_rings_capped_with_overflow_counter():
+    e = SloEngine(
+        target=SloTarget(objective=0.9, min_samples=10),
+        replica="t", max_tenants=2, clock=FakeClock(),
+    )
+    for ns in ("ns-a", "ns-b", "ns-c", "ns-d"):
+        for _ in range(3):
+            e.observe("validation", ok=True, tenant={"namespace": ns})
+    snap = e.snapshot()
+    assert set(snap["tenants"]) == {"validation/ns-a", "validation/ns-b"}
+    assert snap["tenants"]["validation/ns-a"]["requests_fast"] == 3
+    assert snap["tenant_overflow"] == 6  # 2 tenants x 3 observes
+    # tenant-less and empty tenants don't occupy a slot
+    e.observe("validation", ok=True, tenant=None)
+    e.observe("validation", ok=True, tenant={"namespace": ""})
+    assert len(e.snapshot()["tenants"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# saturation / headroom
+
+
+def test_saturation_combines_cost_demand_and_overload():
+    clk = FakeClock()
+    e = engine(clock=clk, fast_window_s=10.0)
+    # pure overload: no cost model yet, 5 of 20 shed -> 0.25
+    for _ in range(15):
+        e.observe("validation", ok=True)
+    for _ in range(5):
+        e.observe("validation", ok=False, shed=True)
+    util = e.snapshot()["utilization"]
+    assert util["saturation"] == 0.25
+    assert util["estimated_headroom_rps"] is None  # no cost samples
+    # cost EWMA x arrival adds the demand term and unlocks headroom
+    e.note_cost(0.02, rows=1)  # 20 ms/row -> capacity 50 rps
+    clk.advance(10.0)  # fresh window
+    e.reset_windows()
+    for _ in range(10):
+        e.observe("validation", ok=True)
+    util = e.snapshot()["utilization"]
+    assert util["estimated_capacity_rps"] == 50.0
+    assert util["device_seconds_per_row_ewma"] == 0.02
+    assert 0.0 < util["saturation"] <= 1.0
+    assert util["estimated_headroom_rps"] is not None
+    # autoscaler block carries the contract fields
+    a = e.autoscaler()
+    for k in ("saturation", "burning", "estimated_headroom_rps",
+              "arrival_rps", "attainment", "objective", "breaches"):
+        assert k in a
+    assert a["burning"] is False and a["attainment"] == 1.0
+
+
+def test_reset_windows_keeps_cost_ewma_and_breaches():
+    rec = RecorderStub()
+    e = engine(recorder=rec)
+    e.note_cost(0.01)
+    for _ in range(10):
+        e.observe("validation", ok=False)
+    assert e.breaches == 1
+    e.reset_windows()
+    snap = e.snapshot()
+    assert snap["planes"] == {} and snap["observed"] == 0
+    assert snap["breaches"] == 1
+    assert snap["utilization"]["device_seconds_per_row_ewma"] == 0.01
+
+
+# ---------------------------------------------------------------------------
+# gauge export: debounced to fast-window slot rolls
+
+
+def test_gauge_export_debounced_to_slot_rolls():
+    clk = FakeClock()
+    m = MetricsStub()
+    e = engine(clock=clk, metrics=m, fast_window_s=60.0)
+    e.observe("validation", ok=True)
+    first = len(m.gauges)
+    assert first > 0
+    names = {g[0] for g in m.gauges}
+    assert {"slo_attainment", "slo_burn_rate",
+            "slo_error_budget_remaining", "slo_saturation"} <= names
+    # same slot: a request storm exports nothing new
+    for _ in range(50):
+        e.observe("validation", ok=True)
+    assert len(m.gauges) == first
+    # next slot (fast_window/12): one more export
+    clk.advance(60.0 / 12 + 0.01)
+    e.observe("validation", ok=True, tenant={"namespace": "ns-a"})
+    assert len(m.gauges) > first
+    clk.advance(60.0 / 12 + 0.01)
+    e.observe("validation", ok=True, tenant={"namespace": "ns-a"})
+    tenant_rows = [g for g in m.gauges if g[0] == "slo_tenant_attainment"]
+    assert tenant_rows and tenant_rows[-1][2] == {
+        "plane": "validation", "tenant": "ns-a",
+    }
+
+
+# ---------------------------------------------------------------------------
+# /debug/slo renderer
+
+
+def test_export_slo_filters():
+    e = engine()
+    e.observe("validation", ok=True, tenant={"namespace": "ns-a"})
+    e.observe("mutation", ok=True, tenant={"namespace": "ns-b"})
+    full = json.loads(export_slo(e))
+    assert set(full["planes"]) == {"validation", "mutation"}
+    assert set(full["tenants"]) == {"validation/ns-a", "mutation/ns-b"}
+    only_v = json.loads(export_slo(e, "/debug/slo?plane=validation"))
+    assert set(only_v["planes"]) == {"validation"}
+    assert set(only_v["tenants"]) == {"validation/ns-a"}
+    no_t = json.loads(export_slo(e, "/debug/slo?tenants=0"))
+    assert "tenants" not in no_t
+
+
+# ---------------------------------------------------------------------------
+# the decision-log seam
+
+
+def test_decision_log_seam_feeds_engine_before_sampling():
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.obs.decisionlog import DecisionLog
+
+    reg = MetricsRegistry()
+    log = DecisionLog(metrics=reg, replica="t", allow_sample_n=1000)
+    e = engine()
+    log.slo = e
+    # plain allows the ring samples out still reach the estimator
+    for i in range(40):
+        log.record_decision(
+            "validation", "allow", duration_ms=5.0,
+            deadline_slack_ms=900.0,
+            tenant={"namespace": "default"},
+        )
+    assert e.observed == 40
+    p = e.snapshot()["planes"]["validation"]
+    assert p["attainment_fast"] == 1.0
+    # shed/unavailable verdicts count in the shed bucket; errors miss
+    log.record_decision("validation", "unavailable")
+    log.record_decision("validation", "error", duration_ms=1.0)
+    p = e.snapshot()["planes"]["validation"]
+    assert p["sheds_fast"] == 1 and p["misses_fast"] == 1
+    # the slack histogram is stamped at the same seam
+    text = reg.prometheus_text()
+    assert "admission_deadline_slack_seconds" in text
+    assert 'plane="validation"' in text
+
+
+def test_decision_log_seam_judges_deadline_over_slack():
+    from gatekeeper_tpu.obs.decisionlog import DecisionLog
+
+    log = DecisionLog(replica="t")
+    e = engine(deadline_s=0.1)
+    log.slo = e
+    # within deadline: ok even with negative slack (the handler's
+    # timeout is not the target's contract)
+    log.record_decision(
+        "validation", "deny", duration_ms=50.0, deadline_slack_ms=-1.0
+    )
+    # over deadline: a miss even though the verdict was produced
+    log.record_decision(
+        "validation", "deny", duration_ms=200.0, deadline_slack_ms=500.0
+    )
+    p = e.snapshot()["planes"]["validation"]
+    assert p["requests_fast"] == 2
+    assert p["misses_fast"] == 1 and p["attainment_fast"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: armed device fault -> breach record -> readyz recovery
+
+
+TARGET_NAME = "admission.k8s.gatekeeper.sh"
+
+DENY_ALL = """package denyall
+
+violation[{"msg": "always denied"}] { true }
+"""
+
+
+def _adm_request(uid, ns="default"):
+    return {
+        "uid": uid,
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": "CREATE",
+        "name": f"p-{uid}",
+        "namespace": ns,
+        "userInfo": {"username": "alice"},
+        "object": {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": f"p-{uid}", "namespace": ns},
+            "spec": {"containers": [{"name": "m", "image": "nginx"}]},
+        },
+    }
+
+
+def _readyz_slo(runner):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{runner.readyz_port}/readyz", timeout=5
+    ) as resp:
+        return json.loads(resp.read())["stats"]["slo"]
+
+
+@pytest.mark.chaos
+def test_slo_breach_e2e_fault_burn_record_and_recovery():
+    """The acceptance e2e on a real Runner: clean traffic, then an
+    armed device fault (both dispatch rungs failed, as in the soak
+    smoke's fault phase) drives fast burn over threshold -> exactly
+    one slo_breach flight record; /readyz stats.slo.saturation rises
+    under the fault and recovers after disarm; /debug/slo serves on
+    both HTTP planes; the record cross-links record -> decisions ->
+    traces by shared trace id."""
+    import time
+
+    from gatekeeper_tpu.constraint import (
+        Backend,
+        K8sValidationTarget,
+        RegoDriver,
+    )
+    from gatekeeper_tpu.control import FakeCluster, Runner
+    from gatekeeper_tpu.faults import FAULTS
+    from gatekeeper_tpu.metrics.registry import serve_metrics
+
+    cluster = FakeCluster()
+    client = Backend(RegoDriver()).new_client(K8sValidationTarget())
+    target = SloTarget(
+        objective=0.9, deadline_s=5.0,
+        fast_window_s=1.0, slow_window_s=4.0, min_samples=10,
+    )
+    runner = Runner(
+        cluster, client, TARGET_NAME,
+        audit_interval=3600.0, readyz_port=0, slo_target=target,
+    )
+    runner.start()
+    try:
+        assert runner.wait_ready(30), runner.tracker.stats()
+        handler = runner.webhook.handler
+
+        # clean phase: answered within deadline, nothing burning
+        for i in range(20):
+            handler.handle(_adm_request(f"c{i}"))
+        clean = _readyz_slo(runner)
+        assert clean["attainment"] == 1.0
+        assert clean["burning"] is False and clean["breaches"] == 0
+        assert clean["objective"] == 0.9
+        clean_sat = clean["saturation"]
+
+        # fault phase: fail the fused path AND the host-oracle rung so
+        # requests resolve EvaluationUnavailable (shed) instead of
+        # being absorbed by the degradation ladder
+        FAULTS.arm("webhook.batch_dispatch", mode="error")
+        FAULTS.arm("webhook.host_review", mode="error")
+        for i in range(30):
+            handler.handle(_adm_request(f"f{i}"))
+        fault = _readyz_slo(runner)
+        assert fault["burning"] is True
+        assert fault["breaches"] == 1
+        assert fault["saturation"] > clean_sat
+        assert fault["saturation"] >= 0.5
+    finally:
+        FAULTS.reset()
+
+    try:
+        # exactly one slo_breach capture (hysteresis: the latch fires
+        # the trigger once per entry, not per burning observation)
+        assert runner.recorder.flush(5.0)
+        breach_events = [
+            t
+            for r in runner.recorder.records()
+            for t in r.get("triggers", [])
+            if t["reason"] == "slo_breach"
+        ]
+        assert len(breach_events) == 1, breach_events
+        ctx = breach_events[0]["context"]
+        assert ctx["plane"] == "validation"
+        assert ctx["burn_rate_fast"] >= target.burn_threshold
+        breach_records = [
+            r for r in runner.recorder.records()
+            if any(
+                t["reason"] == "slo_breach" for t in r.get("triggers", [])
+            )
+        ]
+        assert len(breach_records) == 1
+        record = breach_records[0]
+
+        # cross-link: the record embeds the trigger window's error
+        # decision ids; those ids resolve in the decision ring and the
+        # shared trace id resolves in the tracer
+        embedded = [
+            d for d in record.get("decisions", [])
+            if d["verdict"] == "unavailable"
+        ]
+        assert embedded, record.get("decisions")
+        linked = embedded[0]
+        assert linked["trace_id"]
+        full = runner.decisions.records(trace_id=linked["trace_id"])
+        assert full and full[0]["id"] == linked["id"]
+        assert full[0]["plane"] == "validation"
+        trace = runner.tracer.get(linked["trace_id"])
+        assert trace is not None
+        assert any(s["name"] == "handler" for s in trace["spans"])
+
+        # /debug/slo on the health plane: per-plane + per-tenant rows
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{runner.readyz_port}/debug/slo", timeout=5
+        ) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            body = json.loads(resp.read())
+        assert body["planes"]["validation"]["requests_slow"] >= 50
+        assert body["planes"]["validation"]["sheds_fast"] >= 10
+        assert any(
+            k.startswith("validation/") for k in body["tenants"]
+        )
+        assert body["breaches"] == 1
+
+        # /debug/slo on the metrics plane (the shared renderer)
+        httpd = serve_metrics(
+            runner.metrics, port=0, slo=runner.slo
+        )
+        try:
+            mport = httpd.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/debug/slo?plane=validation",
+                timeout=5,
+            ) as resp:
+                mbody = json.loads(resp.read())
+            assert set(mbody["planes"]) == {"validation"}
+        finally:
+            httpd.shutdown()
+
+        # recovery: fault disarmed, the fast window ages out, clean
+        # traffic clears the latch and saturation falls back
+        time.sleep(target.fast_window_s + 0.3)
+        handler = runner.webhook.handler
+        for i in range(20):
+            handler.handle(_adm_request(f"r{i}"))
+        rec = _readyz_slo(runner)
+        assert rec["burning"] is False
+        assert rec["saturation"] < 0.5
+        assert rec["breaches"] == 1  # no new breach on the way down
+    finally:
+        runner.stop()

@@ -341,6 +341,19 @@ def test_soak_smoke_scenario_end_to_end():
     assert res["faults"], "disarm_faults must log the fired spec"
     fired = res["faults"][0]["disarmed"]
     assert fired.get("webhook.batch_dispatch", {}).get("fired", 0) > 0
+    # live SLO plane (ISSUE 17): the streaming engine measured the
+    # same post-warmup traffic the offline reporter binned — the
+    # report carries the shared target, the live block, and the
+    # live-vs-offline agreement check must hold within tolerance
+    assert "target" in res["slo"]
+    live = res["slo"]["live"]
+    assert live["requests_slow"] >= 50
+    assert 0.0 <= live["saturation"] <= 1.0
+    agree = res["checks"]["live_vs_offline_attainment"]
+    assert agree["agree"] is True, agree
+    # the sampler stamped the live signals into every window
+    for w in res["windows"]:
+        assert "slo_saturation" in w and "slo_burn_fast" in w
     # the SUMMARY line round-trips
     parse_summary_line(summarize_soak(res))
 

@@ -90,6 +90,16 @@ MODE_RESULTS = {
             "batch_occupancy": 12.0,
         }],
     },
+    "slo": {
+        "phases": [{
+            "phase": "device_fault", "slo_attainment": 0.5,
+            "burn_rate_fast": 50.0, "saturation": 0.7,
+        }],
+        "slo_attainment": 0.67, "saturation": 0.01,
+        "burn_rate_fast": 0.0, "headroom_rps": 15000.0,
+        "breaches": 1, "burning": False,
+        "error_budget_remaining": 0.0,
+    },
 }
 
 
@@ -112,7 +122,8 @@ def test_contract_covers_every_bench_mode_flag():
     with open(bench_webhook.__file__) as f:
         src = f.read()
     for mode in ("ladder", "attribution", "partitions", "fleet",
-                 "chaos", "churn", "external", "mutate", "soak"):
+                 "chaos", "churn", "external", "mutate", "soak",
+                 "slo"):
         assert f'"--{mode}"' in src, f"bench flag --{mode} vanished?"
         assert mode in REQUIRED_FIELDS, f"mode {mode!r} unregistered"
     assert "webhook" in REQUIRED_FIELDS  # the default (flagless) lane
@@ -217,6 +228,26 @@ def test_bench_compare_good_directions_are_improvements():
     )
     assert [r["metric"].rsplit(".", 1)[-1] for r in rep2["regressions"]] \
         == ["throughput_rps"]
+
+
+def test_bench_compare_flags_saturation_rise():
+    """The --slo lane's headroom gate: saturation is watched with
+    up-bad direction — a rise past the threshold regresses even when
+    latency held; a fall is an improvement."""
+    base = {"phases": [
+        {"phase": "clean", "saturation": 0.2, "p50_ms": 2.0},
+    ]}
+    cand = {"phases": [
+        {"phase": "clean", "saturation": 0.6, "p50_ms": 2.0},
+    ]}
+    rep = bench_compare.compare_runs(base, cand, threshold=0.20)
+    assert not rep["ok"]
+    flagged = {r["metric"].rsplit(".", 1)[-1] for r in rep["regressions"]}
+    assert flagged == {"saturation"}
+    rep2 = bench_compare.compare_runs(cand, base, threshold=0.20)
+    assert rep2["ok"]
+    leafs = {r["metric"].rsplit(".", 1)[-1] for r in rep2["improvements"]}
+    assert "saturation" in leafs
 
 
 def test_bench_compare_aligns_rows_by_context_not_index():
