@@ -67,6 +67,12 @@ WATCHED: Dict[str, int] = {
     # the feature-liveness mask = the IR pass stopped proving columns
     # dead (host-encode cost regression)
     "columns_skipped_static": -1,
+    # admission scheduler (--sched lane): the worst per-tenant
+    # attainment under the deadline policy dropping = a quota/EDF
+    # regression; fewer predictive sheds under the same overload = the
+    # scheduler fell back to blind tail-drops
+    "tenant_attainment_min": -1,
+    "predicted_miss_shed": -1,
 }
 
 # context keys that make a row's path stable across runs (rungs and

@@ -689,6 +689,206 @@ def run_slo_bench(n_requests=1800, n_constraints=20, err=sys.stderr):
     }
 
 
+def _sched_request(i, cls):
+    """A bench request pinned to one of two tenant namespaces: the
+    25% "quiet" class (well-behaved, inside its fair share) vs the 75%
+    "noisy" class (the overload driver). Both object and oldObject
+    share the metadata dict, so one namespace write covers the
+    decision-log tenant seam and the scheduler quota key."""
+    req = make_request(i)
+    ns = f"ns-{cls}"
+    req["namespace"] = ns
+    req["object"]["metadata"]["namespace"] = ns
+    return req
+
+
+def run_sched_bench(duration_s=6.0, rps=600.0, n_constraints=20,
+                    err=sys.stderr):
+    """The `--sched` lane (docs/operations.md §Admission scheduling):
+    the SAME open-loop two-tenant overload driven first through the
+    legacy FIFO queue, then through the deadline scheduler. Headline:
+    the per-class attainment split (FIFO lets the noisy tenant starve
+    the quiet one; the scheduler caps the noisy tenant at its fair
+    share), and the shed split (predictive `predicted_miss` sheds vs
+    FIFO's blind `queue_full` tail-drops).
+
+    Overload is forced, not hoped for: the review path is throttled to
+    a fixed per-row device cost (~3 ms/row ≈ 333 rps real capacity vs
+    600 offered), the scheduler's cost model is floored to that same
+    cost (the bench knob standing in for a warm attribution EWMA, and
+    seeded into the SLO engine so saturation reads hot from t=0), and
+    the scheduler's overload thresholds are lowered so the ~6 s phase
+    reliably crosses them."""
+    import itertools
+    import threading
+
+    from gatekeeper_tpu.constraint import TpuDriver
+    from gatekeeper_tpu.faults import FAULTS
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.obs import DecisionLog, SloEngine, SloTarget
+    from gatekeeper_tpu.sched import BatchCostModel
+    from gatekeeper_tpu.soak.loadgen import run_open_loop
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    deadline_s = 0.5
+    per_row_s = 3e-3
+    phases = []
+    for policy in ("fifo", "deadline"):
+        metrics = MetricsRegistry()
+        client = build_webhook_client(TpuDriver(), n_constraints)
+        # throttle the review path to a fixed per-row device cost so
+        # real capacity (1/per_row_s ≈ 333 rps) sits well under the
+        # offered rate AND matches the scheduler's cost model exactly
+        # — the predicted-miss arithmetic is judged against reality
+        def throttled_review_many(reviews, _inner=client.review_many):
+            time.sleep(per_row_s * len(reviews))
+            return _inner(reviews)
+
+        client.review_many = throttled_review_many
+        decisions = DecisionLog(metrics=metrics, replica=f"sched-{policy}")
+        target = SloTarget(
+            objective=0.99,
+            deadline_s=deadline_s,
+            fast_window_s=2.0,
+            slow_window_s=10.0,
+        )
+        slo = SloEngine(
+            target=target, metrics=metrics, replica=f"sched-{policy}"
+        )
+        decisions.slo = slo
+        batcher = MicroBatcher(
+            client, TARGET, window_ms=2.0, metrics=metrics,
+            max_queue=256, max_batch=64, decisions=decisions,
+            sched_policy=policy, slo=slo,
+        )
+        # bench knobs (see docstring): deterministic per-row cost floor
+        # so predicted-miss arithmetic has a live cost model from t=0,
+        # and lowered overload thresholds so the short phase crosses
+        # them; production uses the attribution-fed defaults
+        batcher.sched.cost = BatchCostModel(
+            slo=slo, per_row_fn=lambda: per_row_s
+        )
+        batcher.sched.overload_saturation = 0.5
+        batcher.sched.burning_saturation = 0.4
+        handler = BatchedValidationHandler(
+            batcher, request_timeout=deadline_s, metrics=metrics,
+            fail_policy="open", decision_log=decisions,
+        )
+        counter = itertools.count()
+        lock = threading.Lock()
+        per_class = {"quiet": [], "noisy": []}
+
+        def submit(plane):
+            i = next(counter)
+            cls = "quiet" if i % 4 == 0 else "noisy"
+            req = _sched_request(i, cls)
+            t0 = time.perf_counter()
+            try:
+                resp = handler.handle(req)
+                status = 200
+                outcome = "ok" if resp.allowed else "denied"
+            except Exception:
+                status, outcome = 500, "conn_error"
+            lat = time.perf_counter() - t0
+            with lock:
+                per_class[cls].append(lat)
+            return status, outcome
+
+        batcher.start()
+        try:
+            _warm_route(client)
+            replay(
+                handler,
+                [_sched_request(i, "noisy" if i % 4 else "quiet")
+                 for i in range(128)],
+                32,
+            )
+            slo.reset_windows()
+            # seed the saturation signal with the throttled cost so the
+            # feedback loop reads hot from the first arrivals
+            slo.note_cost(per_row_s, rows=1)
+            # warmup traffic already hit the decision log; the phase's
+            # per-class split is the DELTA against this baseline
+            base = decisions.tenant_stats()
+            per_class["quiet"].clear()
+            per_class["noisy"].clear()
+            load = run_open_loop(
+                submit, rps=rps, duration_s=duration_s,
+                deadline_s=deadline_s, seed=99,
+            )
+        finally:
+            batcher.stop()
+            FAULTS.reset()
+        stats = decisions.tenant_stats()
+        classes = {}
+        for cls in ("quiet", "noisy"):
+            key = f"validation/ns-{cls}"
+            row = stats.get(key) or {}
+            b = base.get(key) or {}
+            cnt = row.get("count", 0) - b.get("count", 0)
+            ok = row.get("ok", 0) - b.get("ok", 0)
+            shed = row.get("shed", 0) - b.get("shed", 0)
+            lats = per_class[cls]
+            classes[cls] = {
+                "requests": cnt,
+                "ok": ok,
+                "shed": shed,
+                "attainment": round(ok / cnt, 4) if cnt else None,
+                "p50_ms": (
+                    round(float(np.percentile(lats, 50)) * 1e3, 2)
+                    if lats else None
+                ),
+                "p99_ms": (
+                    round(float(np.percentile(lats, 99)) * 1e3, 2)
+                    if lats else None
+                ),
+            }
+        snap = batcher.sched.snapshot()
+        phase = {
+            "phase": policy,
+            "generated": load.generated,
+            "achieved_rps": load.achieved_rps,
+            "open_loop_attainment": round(load.slo_attainment(), 4),
+            "classes": classes,
+            "sheds": snap["sheds"],
+            "admitted": snap["admitted"],
+            "overloaded": snap["overloaded"],
+            "saturation": snap["saturation"],
+            "tenants": snap["tenants"],
+        }
+        phases.append(phase)
+        print(f"sched phase: {policy} classes={classes} "
+              f"sheds={snap['sheds']}", file=err)
+
+    fifo, dl = phases[0], phases[1]
+    atts = [
+        c["attainment"] for c in dl["classes"].values()
+        if c["attainment"] is not None
+    ]
+    return {
+        "constraints": n_constraints,
+        "target_rps": rps,
+        "duration_s": duration_s,
+        "deadline_s": deadline_s,
+        "phases": phases,
+        # headline: the deadline phase's per-class split, the worst
+        # per-tenant attainment under the scheduler (bench_compare
+        # watches it down-bad), and predictive vs blind shed counts
+        "quiet_p50_ms": dl["classes"]["quiet"]["p50_ms"],
+        "quiet_p99_ms": dl["classes"]["quiet"]["p99_ms"],
+        "noisy_p50_ms": dl["classes"]["noisy"]["p50_ms"],
+        "noisy_p99_ms": dl["classes"]["noisy"]["p99_ms"],
+        "quiet_attainment": dl["classes"]["quiet"]["attainment"],
+        "noisy_attainment": dl["classes"]["noisy"]["attainment"],
+        "tenant_attainment_min": min(atts) if atts else None,
+        "predicted_miss_shed": dl["sheds"].get("predicted_miss", 0),
+        "blind_shed": fifo["sheds"].get("queue_full", 0),
+    }
+
+
 def build_partition_client(driver, n_constraints):
     """Policy load for the --partitions lane: ONE template, n
     constraints named w000..wNNN (zero-padded so the driver's sorted
@@ -2199,6 +2399,14 @@ def _summarize(mode, res):
                       "error_budget_remaining"):
                 if k in res:
                     head[k] = res[k]
+        elif mode == "sched":
+            head["phases"] = len(res.get("phases") or [])
+            for k in ("quiet_p50_ms", "quiet_p99_ms", "noisy_p50_ms",
+                      "noisy_p99_ms", "quiet_attainment",
+                      "noisy_attainment", "tenant_attainment_min",
+                      "predicted_miss_shed", "blind_shed"):
+                if k in res:
+                    head[k] = res[k]
         elif mode == "mutate":
             replays = res.get("replays") or []
             if replays:
@@ -2359,6 +2567,13 @@ if __name__ == "__main__":
         res = run_slo_bench(n_req, n_con)
         print(json.dumps(res))
         print(_summarize("slo", res))
+    elif "--sched" in sys.argv:
+        pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+        dur = float(pos[0]) if pos else 6.0
+        rps = float(pos[1]) if len(pos) > 1 else 600.0
+        res = run_sched_bench(dur, rps)
+        print(json.dumps(res))
+        print(_summarize("sched", res))
     else:
         n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
         n_con = int(sys.argv[2]) if len(sys.argv) > 2 else 50

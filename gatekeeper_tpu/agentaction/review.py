@@ -22,7 +22,11 @@ from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Any, Dict, List, Optional
 
 from ..faults import AdmissionUnavailable, EvaluationTimeout
-from ..webhook.policy import AdmissionResponse, unavailable_response
+from ..webhook.policy import (
+    AdmissionResponse,
+    note_unavailable_decision,
+    unavailable_response,
+)
 from ..webhook.server import DEFAULT_REQUEST_TIMEOUT
 from .target import AgentAction
 
@@ -82,7 +86,10 @@ class AgentReviewHandler:
             agent=str(request.get("agent", "")),
             session=str(request.get("session", "")),
         ) as span:
-            resp = self._handle(request, span)
+            # shed/unavailable outcomes override the verdict below — a
+            # fail-open shed must NOT be recorded as a healthy allow
+            decision: Dict[str, Any] = {}
+            resp = self._handle(request, span, decision)
             span.set_attr(
                 admission_status=(
                     "allow" if resp.allowed
@@ -106,9 +113,10 @@ class AgentReviewHandler:
                 admission_status=status,
             )
         if self.decision_log is not None:
+            verdict = decision.pop("verdict", None) or status
             self.decision_log.record_decision(
                 "agent",
-                status,
+                verdict,
                 code=resp.code,
                 trace_id=getattr(span, "trace_id", None) or trace_id,
                 duration_ms=duration_s * 1e3,
@@ -122,10 +130,13 @@ class AgentReviewHandler:
                 ),
                 tool=str(request.get("tool", "")),
                 patch_ops=len(resp.patch or []),
+                **decision,
             )
         return resp
 
-    def _handle(self, request: Dict[str, Any], span=None) -> AdmissionResponse:
+    def _handle(
+        self, request: Dict[str, Any], span=None, decision=None
+    ) -> AdmissionResponse:
         if not isinstance(request, dict) or not str(
             request.get("tool") or ""
         ):
@@ -142,8 +153,17 @@ class AgentReviewHandler:
         try:
             if self.mutate_batcher is not None:
                 patch, record = self._mutate(record, ctx)
+            # tenant identity (agent + session) extracted BEFORE
+            # enqueue: shed verdicts carry it, and the scheduler's
+            # fair-share quotas key on it
             deadline = self.batcher._now() + self.request_timeout
-            fut = self.batcher.submit(record, span_ctx=ctx, deadline=deadline)
+            tenant = {
+                "agent": str(request.get("agent", "")),
+                "session": str(request.get("session", "")),
+            }
+            fut = self.batcher.submit(
+                record, span_ctx=ctx, deadline=deadline, tenant=tenant
+            )
             try:
                 results = fut.result(timeout=self.request_timeout)
             except _FutureTimeout:
@@ -151,6 +171,8 @@ class AgentReviewHandler:
                     f"agent review exceeded {self.request_timeout}s"
                 ) from None
         except AdmissionUnavailable as e:
+            if decision is not None:
+                note_unavailable_decision(decision, e)
             return unavailable_response(
                 e, fail_policy=self.fail_policy, metrics=self.metrics,
                 log=self.log, span=span, plane="agent",
@@ -236,6 +258,9 @@ def make_agent_plane(
     request_timeout: float = DEFAULT_REQUEST_TIMEOUT,
     max_queue=None,
     decision_log=None,
+    sched_policy: str = "fifo",
+    slo=None,
+    attributor=None,
 ):
     """Wire the agent serving plane over an already-registered agent
     target: (review MicroBatcher, optional MutateBatcher,
@@ -253,6 +278,9 @@ def make_agent_plane(
         tracer=tracer,
         max_queue=max_queue if max_queue is not None else DEFAULT_MAX_QUEUE,
         decisions=decision_log,
+        sched_policy=sched_policy,
+        slo=slo,
+        attributor=attributor,
     )
     mutate_batcher = None
     if mutation_system is not None:
@@ -263,6 +291,9 @@ def make_agent_plane(
             tracer=tracer,
             max_queue=max_queue if max_queue is not None else DEFAULT_MAX_QUEUE,
             decisions=decision_log,
+            sched_policy=sched_policy,
+            slo=slo,
+            attributor=attributor,
         )
     handler = AgentReviewHandler(
         batcher,

@@ -127,6 +127,12 @@ class Runner:
         # admission against. None = defaults (99% within the handler's
         # own deadline slack, 60s/900s burn windows).
         slo_target=None,
+        # admission scheduling policy (docs/operations.md §Admission
+        # scheduling): "deadline" turns on EDF batch formation,
+        # per-tenant fair-share quotas, and predictive shedding;
+        # "fifo" is the bit-compatible legacy queue and the rollback
+        # path (--sched-policy fifo)
+        sched_policy: str = "fifo",
     ):
         from ..logs import null_logger
         from ..obs import (
@@ -214,6 +220,7 @@ class Runner:
         self.fail_policy = fail_policy
         self.max_queue = max_queue
         self.partitions = int(partitions or 0)
+        self.sched_policy = sched_policy
         self.drain_grace_s = drain_grace_s
         self.exempt_namespaces = list(exempt_namespaces)
         self.webhook_tls = webhook_tls
@@ -535,6 +542,8 @@ class Runner:
                 attributor=self.attributor,
                 replica=self.pod_name,
                 corpus=self.corpus,
+                sched_policy=self.sched_policy,
+                slo=self.slo,
             )
             # postmortem state sources: what a flight record snapshots
             # alongside the trace tail / cost table / fault points
@@ -973,6 +982,15 @@ class Runner:
                     # breakdown at /debug/slo); docs/observability.md
                     # §SLO & saturation
                     stats["slo"] = runner.slo.autoscaler()
+                    # admission-scheduler headline: per-plane policy,
+                    # overload state, shed split, and per-tenant
+                    # quota/usage table (full payload at /debug/sched;
+                    # docs/operations.md §Admission scheduling)
+                    wh = getattr(runner, "webhook", None)
+                    if wh is not None and hasattr(
+                        wh, "sched_snapshot"
+                    ):
+                        stats["sched"] = wh.sched_snapshot()
                     # corpus analysis headline (docs/analysis.md
                     # §Corpus analysis): diagnostic counts + the
                     # dead/prunable/shadowed rollup; recompute is
@@ -1093,6 +1111,26 @@ class Runner:
                         runner.decisions, self.path
                     ).encode()
                     self.send_response(200)
+                elif self.path.split("?")[0] == "/debug/sched":
+                    # admission-scheduler plane: per-plane policy /
+                    # overload / shed counters + per-tenant fair-share
+                    # quota table — ?plane=/?tenants=0
+                    # (docs/operations.md §Admission scheduling)
+                    from ..sched import export_sched
+
+                    wh = getattr(runner, "webhook", None)
+                    if wh is not None and hasattr(
+                        wh, "sched_snapshot"
+                    ):
+                        payload = export_sched(
+                            wh.sched_snapshot(), self.path
+                        ).encode()
+                        self.send_response(200)
+                    else:
+                        payload = (
+                            b'{"error": "webhook not running"}'
+                        )
+                        self.send_response(404)
                 elif self.path.split("?")[0] == "/debug/slo":
                     # live SLO plane: per-plane/per-tenant attainment,
                     # burn rates, saturation/headroom — ?plane=/

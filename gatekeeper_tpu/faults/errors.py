@@ -20,9 +20,30 @@ class AdmissionUnavailable(RuntimeError):
 
 
 class ShedError(AdmissionUnavailable):
-    """Dropped by the bounded admission queue under overload."""
+    """Dropped by the admission scheduler under overload.
+
+    `reason` distinguishes the shed classes (decision records carry it):
+    `queue_full` (bounded queue at capacity), `predicted_miss` (the
+    scheduler proved the deadline unmakeable — `predicted_slack_ms` is
+    the negative slack), `tenant_capped` (per-tenant fair-share quota
+    exhausted while the plane is overloaded). `tenant_capped` also
+    rides as a boolean alongside the other reasons: whether the tenant
+    was over its share when the shed happened."""
 
     reason = "queue_full"
+
+    def __init__(
+        self,
+        message: str = "",
+        reason: str = None,
+        predicted_slack_ms: float = None,
+        tenant_capped: bool = False,
+    ):
+        super().__init__(message)
+        if reason is not None:
+            self.reason = reason
+        self.predicted_slack_ms = predicted_slack_ms
+        self.tenant_capped = tenant_capped
 
 
 class DeadlineExceeded(AdmissionUnavailable):

@@ -411,6 +411,7 @@ def serve_metrics(
     decisions=None,
     partitions=None,
     slo=None,
+    sched=None,
 ) -> ThreadingHTTPServer:
     """Serve /metrics (Prometheus text) on a background thread; returns
     the server (server_address[1] carries the bound port). The reference
@@ -421,7 +422,10 @@ def serve_metrics(
     /debug/costs (the top-K cost table), a flight recorder adds
     /debug/flightrecords, a decision log adds /debug/decisions, an SLO
     engine adds /debug/slo (live attainment/burn/saturation,
-    docs/observability.md §SLO & saturation), and a
+    docs/observability.md §SLO & saturation), a sched callable
+    (returning per-plane scheduler snapshots) adds /debug/sched
+    (admission scheduling: policy/overload/shed split + per-tenant
+    quota table, docs/operations.md §Admission scheduling), and a
     partition dispatcher adds /debug/partitions (the live cost/locality
     plan composition) and /debug/programs (the compile plane: per-
     partition sub-program signatures + program-store stats,
@@ -456,6 +460,13 @@ def serve_metrics(
                 from ..obs.slo import export_slo
 
                 payload = export_slo(slo, self.path).encode()
+                ctype = "application/json"
+            elif sched is not None and route == "/debug/sched":
+                from ..sched import export_sched
+
+                payload = export_sched(
+                    sched() if callable(sched) else sched, self.path
+                ).encode()
                 ctype = "application/json"
             elif partitions is not None and route == "/debug/partitions":
                 payload = json.dumps(partitions.plan_table()).encode()

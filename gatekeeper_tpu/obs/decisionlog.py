@@ -170,6 +170,12 @@ class DecisionLog:
         self.dropped = 0
         self.denial_log_dropped = 0
         self.route_counts: Dict[str, int] = {}
+        # exact per-tenant verdict accounting over the FULL decision
+        # stream (counted before sampling/rate gates — the ring only
+        # samples, but attainment splits must be exact): the soak
+        # reporter's per-tenant SLO-attainment source
+        self._tenant_counts: Dict[str, Dict[str, int]] = {}
+        self._tenant_counts_max = 64
 
     # -- dispatch facts (the batch worker's half) -----------------------------
 
@@ -260,6 +266,9 @@ class DecisionLog:
         self._observe_slo(
             plane, verdict, duration_ms, deadline_slack_ms, tenant
         )
+        self._note_tenant(
+            plane, verdict, duration_ms, deadline_slack_ms, tenant
+        )
         try:
             return self._record(
                 plane, verdict, code, trace_id, duration_ms, tenant,
@@ -313,6 +322,81 @@ class DecisionLog:
             )
         except Exception:
             pass
+
+    @staticmethod
+    def _tenant_label(plane: str, tenant) -> Optional[str]:
+        """`plane/name` identity matching the SLO engine's tenant key
+        convention (namespace or agent or username)."""
+        if not tenant:
+            return None
+        if isinstance(tenant, dict):
+            name = str(
+                tenant.get("namespace") or tenant.get("agent")
+                or tenant.get("username") or ""
+            )
+        else:
+            name = str(tenant)
+        return f"{plane}/{name}" if name else None
+
+    def _note_tenant(
+        self, plane, verdict, duration_ms, deadline_slack_ms, tenant,
+    ) -> None:
+        """Exact per-tenant ok/miss/shed counters over the full stream;
+        ok is judged the same way `_observe_slo` judges it (the SLO
+        target deadline when configured, else the handler slack)."""
+        try:
+            key = self._tenant_label(plane, tenant)
+            if key is None:
+                return
+            shed = verdict in ("shed", "unavailable")
+            if shed or verdict == "error":
+                ok = False
+            else:
+                slo = self.slo
+                deadline = (
+                    getattr(slo.target, "deadline_s", None)
+                    if slo is not None else None
+                )
+                if deadline is not None and duration_ms is not None:
+                    ok = duration_ms / 1e3 <= deadline
+                elif deadline_slack_ms is not None:
+                    ok = deadline_slack_ms >= 0.0
+                else:
+                    ok = True
+            with self._lock:
+                st = self._tenant_counts.get(key)
+                if st is None:
+                    if len(self._tenant_counts) >= self._tenant_counts_max:
+                        key = f"{plane}/(other)"
+                        st = self._tenant_counts.get(key)
+                    if st is None:
+                        st = self._tenant_counts[key] = {
+                            "count": 0, "ok": 0, "miss": 0, "shed": 0,
+                        }
+                st["count"] += 1
+                if shed:
+                    st["shed"] += 1
+                elif ok:
+                    st["ok"] += 1
+                else:
+                    st["miss"] += 1
+        except Exception:
+            pass
+
+    def tenant_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant attainment/shed split read straight from the
+        decision stream (exact, not sampled): `{plane/name: {count, ok,
+        miss, shed, attainment}}` — the soak reporter's headline for
+        the multi-tenant overload scenario."""
+        with self._lock:
+            out: Dict[str, Dict[str, Any]] = {}
+            for key, st in sorted(self._tenant_counts.items()):
+                row = dict(st)
+                row["attainment"] = (
+                    round(st["ok"] / st["count"], 6) if st["count"] else None
+                )
+                out[key] = row
+            return out
 
     def _record(
         self, plane, verdict, code, trace_id, duration_ms, tenant,
@@ -485,4 +569,5 @@ class DecisionLog:
                 "retained": len(self._ring),
                 "pending_facts": len(self._facts),
                 "routes": dict(self.route_counts),
+                "tenant_keys": len(self._tenant_counts),
             }

@@ -419,6 +419,14 @@ class SloEngine:
                 self._cost_ewma = a * per_row + (1 - a) * self._cost_ewma
             self._cost_samples += 1
 
+    def cost_per_row(self) -> Optional[float]:
+        """The live device-seconds-per-row EWMA (None until the first
+        `note_cost`). The admission scheduler's `BatchCostModel` reads
+        this to predict a candidate batch's device seconds before the
+        cut (gatekeeper_tpu/sched/)."""
+        with self._lock:
+            return self._cost_ewma
+
     # -- burn-rate evaluation ------------------------------------------------
 
     def _burn(self, totals: Dict[str, int]) -> float:

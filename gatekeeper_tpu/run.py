@@ -30,6 +30,12 @@ with the selected operations; flags mirror the reference's surface:
   --fail-policy          open|closed — what a shed/expired/unevaluable
                          request gets (docs/robustness.md)
   --max-queue            admission queue bound (0 = unbounded)
+  --sched-policy         fifo|deadline — admission scheduling policy
+                         (docs/operations.md §Admission scheduling);
+                         "deadline" enables EDF batch formation,
+                         per-tenant fair-share quotas, and predictive
+                         shedding; "fifo" is the bit-compatible legacy
+                         queue and the rollback path
   --drain-grace          seconds /readyz reports not-ready before the
                          webhook listener closes on SIGTERM (graceful
                          drain, docs/robustness.md)
@@ -81,6 +87,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fail-policy", default="open",
                    choices=["open", "closed"])
     p.add_argument("--max-queue", type=int, default=2048)
+    # SLO-aware admission scheduling (docs/operations.md §Admission
+    # scheduling): deadline = EDF batch formation + fair-share quotas
+    # + predictive shedding; fifo = legacy queue (rollback path)
+    p.add_argument("--sched-policy", default="fifo",
+                   choices=["fifo", "deadline"])
     p.add_argument(
         "--partitions", type=int, default=0,
         help="split the constraint corpus into N device fault domains "
@@ -160,6 +171,7 @@ def build_runner(args, log=None, webhook_tls: bool = True):
             getattr(args, "max_queue", 2048) or None
         ),  # 0 -> unbounded
         partitions=getattr(args, "partitions", 0),
+        sched_policy=getattr(args, "sched_policy", "fifo"),
         drain_grace_s=getattr(args, "drain_grace", 0.0),
         bind_addr="0.0.0.0",  # kubelet probes and the apiserver dial
         # the pod IP, not loopback
@@ -205,6 +217,9 @@ def main(argv=None) -> int:
                     runner.webhook, "partitioner", None
                 ),
                 slo=runner.slo,
+                sched=getattr(
+                    runner.webhook, "sched_snapshot", None
+                ),
             )
             log.info(
                 "metrics serving", prometheus_port=args.prometheus_port

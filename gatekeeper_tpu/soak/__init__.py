@@ -39,6 +39,8 @@ from .scenario import (  # noqa: F401
     ScenarioEvent,
     default_scenario,
     load_scenario,
+    multi_tenant_overload_scenario,
+    multi_tenant_smoke_scenario,
     smoke_scenario,
 )
 from .harness import SoakHarness, run_soak  # noqa: F401

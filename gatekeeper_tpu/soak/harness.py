@@ -444,6 +444,14 @@ class SoakHarness:
             log_denies=True,
             recorder=rep.recorder,
             decision_log=rep.decisions,
+            # admission scheduling (docs/operations.md §Admission
+            # scheduling): the scenario's policy on every batcher
+            # plane, fed by the replica's own streaming SLO engine
+            # (saturation feedback) and cost attributor (batch cost
+            # prediction seeds)
+            sched_policy=scn.sched_policy,
+            slo=rep.slo,
+            attributor=rep.attributor,
         )
         rep.recorder.add_source(
             "webhook", lambda rep=rep: {
@@ -544,6 +552,20 @@ class SoakHarness:
 
     def _pod_request(self, i: int, violating: bool) -> Dict[str, Any]:
         req = _pod_request(i, violating, self.scenario.external_keys)
+        tn = self.scenario.tenants
+        if tn is not None:
+            # two-tenant mix (multi_tenant_overload): a deterministic
+            # noisy/quiet namespace split — the scheduler's fair-share
+            # quotas key on the namespace, and the sampler reads each
+            # class's attainment/shed from the decision log
+            frac = float(tn.get("noisy_fraction", 0.75))
+            ns = (
+                str(tn.get("noisy_ns", "ns-noisy"))
+                if (i % 100) < int(round(frac * 100))
+                else str(tn.get("quiet_ns", "ns-quiet"))
+            )
+            req["namespace"] = ns
+            req["object"]["metadata"]["namespace"] = ns
         loc = self._locality
         if loc is not None:
             # deterministic 90/10 (skew) namespace split: the hot
@@ -839,6 +861,17 @@ class SoakHarness:
         degraded = 0  # webhook_degraded_dispatch_total across planes
         program_swaps = program_carryforwards = program_compiles = 0
         corpus_recomputes = 0  # corpus-analysis background refreshes
+        # admission scheduler (gatekeeper_tpu/sched): shed split by
+        # typed reason + per-tenant-class attainment read straight
+        # from the decision log's full-stream tenant counters
+        sched_pred = sched_capped = sched_qfull = sched_throttled = 0
+        tn = self.scenario.tenants or {}
+        quiet_ns = str(tn.get("quiet_ns", "ns-quiet"))
+        noisy_ns = str(tn.get("noisy_ns", "ns-noisy"))
+        tclass = {
+            "quiet": {"count": 0, "ok": 0, "shed": 0},
+            "noisy": {"count": 0, "ok": 0, "shed": 0},
+        }
         for rep in self.replicas:
             for b in (
                 rep.server.batcher,
@@ -849,6 +882,29 @@ class SoakHarness:
                 if b is not None:
                     shed += b.shed_count
                     failures += b.batch_failures
+                    sched = getattr(b, "sched", None)
+                    if sched is not None:
+                        ss = sched.snapshot()
+                        sched_pred += ss["sheds"]["predicted_miss"]
+                        sched_capped += ss["sheds"]["tenant_capped"]
+                        sched_qfull += ss["sheds"]["queue_full"]
+                        sched_throttled += sum(
+                            t["throttled"]
+                            for t in ss["tenants"].values()
+                        )
+            if self.scenario.tenants and rep.decisions is not None:
+                for key, row in rep.decisions.tenant_stats().items():
+                    name = key.split("/", 1)[-1]
+                    cls = (
+                        "quiet" if name == quiet_ns
+                        else "noisy" if name == noisy_ns
+                        else None
+                    )
+                    if cls is None:
+                        continue
+                    tclass[cls]["count"] += row["count"]
+                    tclass[cls]["ok"] += row["ok"]
+                    tclass[cls]["shed"] += row["shed"]
             cache_entries += len(rep.external.cache)
             cache_evictions += rep.external.cache.evictions
             trace_ring += rep.tracer.size()["ring"]
@@ -965,6 +1021,11 @@ class SoakHarness:
             "partitions_touched_p50": pt_p50,
             "partitions_touched_max": pt_max,
             "degraded_cum": degraded,
+            "sched_predicted_miss_cum": sched_pred,
+            "sched_tenant_capped_cum": sched_capped,
+            "sched_queue_full_cum": sched_qfull,
+            "sched_throttled_cum": sched_throttled,
+            "tenant_class_cum": tclass,
             "program_swaps_cum": program_swaps,
             "program_carryforwards_cum": program_carryforwards,
             "program_compiles_cum": program_compiles,
@@ -1046,6 +1107,43 @@ class SoakHarness:
                 "degraded_dispatches": (
                     cur["degraded_cum"] - prev["degraded_cum"]
                 ),
+                # admission scheduler: typed shed split this window +
+                # the per-tenant-class attainment/shed deltas read
+                # from the decision log (multi_tenant_overload's
+                # evidence columns)
+                "sched_predicted_miss": (
+                    cur["sched_predicted_miss_cum"]
+                    - prev["sched_predicted_miss_cum"]
+                ),
+                "sched_tenant_capped": (
+                    cur["sched_tenant_capped_cum"]
+                    - prev["sched_tenant_capped_cum"]
+                ),
+                "sched_queue_full": (
+                    cur["sched_queue_full_cum"]
+                    - prev["sched_queue_full_cum"]
+                ),
+                "sched_throttled": (
+                    cur["sched_throttled_cum"]
+                    - prev["sched_throttled_cum"]
+                ),
+                "tenant_classes": {
+                    cls: {
+                        "requests": (
+                            cur["tenant_class_cum"][cls]["count"]
+                            - prev["tenant_class_cum"][cls]["count"]
+                        ),
+                        "ok": (
+                            cur["tenant_class_cum"][cls]["ok"]
+                            - prev["tenant_class_cum"][cls]["ok"]
+                        ),
+                        "shed": (
+                            cur["tenant_class_cum"][cls]["shed"]
+                            - prev["tenant_class_cum"][cls]["shed"]
+                        ),
+                    }
+                    for cls in ("quiet", "noisy")
+                } if self.scenario.tenants else None,
                 "program_swaps": (
                     cur["program_swaps_cum"] - prev["program_swaps_cum"]
                 ),
@@ -1237,9 +1335,42 @@ class SoakHarness:
                 "warmup_seconds": round(warm_s, 1),
                 "provider_fetches_total": self.stub.fetches,
                 "flight_records": flight,
+                "sched": self._sched_summary(),
             },
         )
         return report
+
+    def _sched_summary(self) -> Dict[str, Any]:
+        """End-of-run admission-scheduler rollup: per-replica plane
+        snapshots (the same document /debug/sched serves) plus the
+        decision-log per-tenant attainment split the acceptance checks
+        read."""
+        out: Dict[str, Any] = {
+            "policy": self.scenario.sched_policy,
+            "replicas": [],
+            "tenant_stats": {},
+        }
+        for rep in self.replicas:
+            if rep.server is not None and hasattr(
+                rep.server, "sched_snapshot"
+            ):
+                out["replicas"].append({
+                    "replica": rep.name,
+                    "planes": rep.server.sched_snapshot(),
+                })
+            if rep.decisions is not None:
+                for key, row in rep.decisions.tenant_stats().items():
+                    agg = out["tenant_stats"].setdefault(
+                        key, {"count": 0, "ok": 0, "miss": 0, "shed": 0}
+                    )
+                    for f in ("count", "ok", "miss", "shed"):
+                        agg[f] += row[f]
+        for row in out["tenant_stats"].values():
+            row["attainment"] = (
+                round(row["ok"] / row["count"], 4)
+                if row["count"] else None
+            )
+        return out
 
     def _live_slo_summary(self) -> Optional[Dict[str, Any]]:
         """End-of-run rollup of the per-replica streaming SLO engines:

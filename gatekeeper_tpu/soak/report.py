@@ -175,7 +175,44 @@ def aggregate_phases(windows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "corpus_recomputes": sum(
                 w.get("corpus_recomputes", 0) or 0 for w in ws
             ),
+            # admission scheduler (gatekeeper_tpu/sched): the typed
+            # shed split for the phase — predictive sheds are the ones
+            # that provably could not make their deadline
+            "sched_predicted_miss": sum(
+                w.get("sched_predicted_miss", 0) or 0 for w in ws
+            ),
+            "sched_tenant_capped": sum(
+                w.get("sched_tenant_capped", 0) or 0 for w in ws
+            ),
+            "sched_queue_full": sum(
+                w.get("sched_queue_full", 0) or 0 for w in ws
+            ),
+            "tenant_classes": _phase_tenant_classes(ws),
         })
+    return out
+
+
+def _phase_tenant_classes(
+    ws: List[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """Aggregate the sampler's per-window tenant-class deltas over a
+    phase; attainment is server-side (decision-log judged), which is
+    what the multi-tenant checks read."""
+    rows = [w.get("tenant_classes") for w in ws]
+    rows = [r for r in rows if r]
+    if not rows:
+        return None
+    out: Dict[str, Any] = {}
+    for cls in ("quiet", "noisy"):
+        req = sum(r[cls]["requests"] for r in rows if cls in r)
+        ok = sum(r[cls]["ok"] for r in rows if cls in r)
+        shed = sum(r[cls]["shed"] for r in rows if cls in r)
+        out[cls] = {
+            "requests": req,
+            "ok": ok,
+            "shed": shed,
+            "attainment": round(ok / req, 4) if req else None,
+        }
     return out
 
 
@@ -257,6 +294,7 @@ def build_checks(
     transitions: List[Dict[str, Any]],
     windows: List[Dict[str, Any]],
     target=None,
+    scenario: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     # degrade/recover thresholds come from the shared SloTarget
     # (scenario-overridable), not hardcoded here — the live engine and
@@ -313,6 +351,37 @@ def build_checks(
         checks["replica_kill_shed_bounded"] = (
             failed / kill["requests"] <= KILL_SHED_BOUND
         )
+    # multi-tenant overload (docs/operations.md §Admission scheduling):
+    # judged over the `overload` phase's decision-log tenant split.
+    # With the deadline policy the quiet tenant must hold the SLO
+    # objective while the noisy one absorbs the shed (fair-share caps
+    # + predictive shedding); the SAME scenario under fifo is the
+    # baseline where both classes degrade together — the contrast the
+    # acceptance criteria demand.
+    overload = by_name.get("overload")
+    tcls = (overload or {}).get("tenant_classes")
+    if tcls and (tcls["quiet"]["requests"] or 0) >= 20:
+        policy = str((scenario or {}).get("sched_policy") or "fifo")
+        quiet_att = tcls["quiet"]["attainment"] or 0.0
+        noisy_att = tcls["noisy"]["attainment"] or 0.0
+        if policy == "deadline":
+            checks["quiet_tenant_attainment_holds"] = {
+                "quiet_attainment": quiet_att,
+                "noisy_attainment": noisy_att,
+                "noisy_shed": tcls["noisy"]["shed"],
+                "objective": target.objective,
+                "holds": bool(
+                    quiet_att >= target.objective
+                    and tcls["noisy"]["shed"] > 0
+                ),
+            }
+        else:
+            checks["fifo_baseline_degrades"] = {
+                "quiet_attainment": quiet_att,
+                "noisy_attainment": noisy_att,
+                "objective": target.objective,
+                "degrades": bool(quiet_att < target.objective),
+            }
     checks["leak_flat"] = bool(leak.get("flat"))
     steady_windows = [
         w for w in windows if (w.get("phase") or "") == "steady"
@@ -360,7 +429,8 @@ def build_report(
     leak = leak_report(windows)
     target = _slo_target(scenario_dict)
     checks = build_checks(
-        phases, leak, transitions, windows, target=target
+        phases, leak, transitions, windows, target=target,
+        scenario=scenario_dict,
     )
     total = len(load.samples)
     ok = sum(
@@ -501,6 +571,15 @@ def summarize_soak(res: Dict[str, Any]) -> str:
             for fr in (res.get("flight_records") or [])
         )
         head["leak_flagged"] = (res.get("leak") or {}).get("flagged")
+        # admission scheduler headline (optional: only runs with the
+        # sched plane wired carry it — older artifacts stay valid)
+        sched = res.get("sched") or {}
+        if sched:
+            head["sched_policy"] = sched.get("policy")
+            head["predicted_miss_shed"] = sum(
+                p.get("sched_predicted_miss", 0) or 0
+                for p in (res.get("phases") or [])
+            )
         # live SLO headline (optional: only runs with streaming
         # engines attached carry it — older artifacts stay valid)
         live = (res.get("slo") or {}).get("live") or {}

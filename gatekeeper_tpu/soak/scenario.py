@@ -155,6 +155,18 @@ class Scenario:
     # recover phase thresholds). deadline_s defaults to the scenario's
     # own deadline contract; unknown keys are rejected at load time.
     slo: Optional[Dict[str, Any]] = None
+    # admission scheduling policy for every replica's batcher planes
+    # (docs/operations.md §Admission scheduling): "deadline" = EDF
+    # batch formation + per-tenant fair-share quotas + predictive
+    # shedding; "fifo" = the bit-compatible legacy queue (the
+    # multi-tenant overload baseline runs use it for the contrast)
+    sched_policy: str = "fifo"
+    # two-tenant traffic mix (the multi_tenant_overload scenario):
+    # {"noisy_fraction": 0.75, "quiet_ns": "...", "noisy_ns": "..."} —
+    # `noisy_fraction` of validation/mutation requests land on the
+    # noisy namespace, the rest on the quiet one; the sampler reads
+    # each class's attainment/shed split from the decision log
+    tenants: Optional[Dict[str, Any]] = None
     events: List[ScenarioEvent] = field(default_factory=list)
 
     def slo_target(self):
@@ -184,6 +196,19 @@ class Scenario:
                 )
         if sum(self.planes.values()) <= 0:
             raise ValueError("plane weights must sum to > 0")
+        from ..sched import POLICIES
+
+        if self.sched_policy not in POLICIES:
+            raise ValueError(
+                f"sched_policy must be one of {POLICIES}, "
+                f"got {self.sched_policy!r}"
+            )
+        if self.tenants is not None:
+            frac = float(self.tenants.get("noisy_fraction", 0.75))
+            if not (0.0 < frac < 1.0):
+                raise ValueError(
+                    "tenants.noisy_fraction must be in (0, 1)"
+                )
         # a typoed slo override must fail the load, not the analysis
         self.slo_target()
         for ev in self.events:
@@ -218,7 +243,7 @@ class Scenario:
             "seed", "replicas", "tls", "constraints", "external_keys",
             "violating_fraction", "window_ms", "min_device_batch",
             "partitions", "planes", "breaker", "capacity", "slo",
-            "events",
+            "sched_policy", "tenants", "events",
         }
         unknown = set(d) - known
         if unknown:
@@ -251,6 +276,8 @@ class Scenario:
             "breaker": dict(self.breaker),
             "capacity": self.capacity,
             "slo": self.slo,
+            "sched_policy": self.sched_policy,
+            "tenants": dict(self.tenants) if self.tenants else None,
             "events": [e.to_dict() for e in self.events],
         }
 
@@ -300,6 +327,79 @@ def smoke_scenario() -> Scenario:
             # serve every request through it (ingest_zero_degraded)
             {"at": 9.0, "action": "phase", "name": "ingest"},
             {"at": 9.2, "action": "ingest_wave", "count": 6},
+        ],
+    })
+
+
+def multi_tenant_overload_scenario(
+    sched_policy: str = "deadline",
+) -> Scenario:
+    """The scheduler acceptance run (docs/operations.md §Admission
+    scheduling): two tenant classes — a noisy namespace carrying 3/4 of
+    arrivals and a quiet one carrying the rest — driven at roughly 2×
+    the single-replica capacity so the plane saturates. With
+    `sched_policy="deadline"` the fair-share quotas cap the noisy
+    tenant at its share and predictive shedding drops only provably
+    doomed requests, so the quiet tenant's attainment holds at the SLO
+    objective (`quiet_tenant_attainment_holds`); the same scenario
+    with `"fifo"` is the baseline where both classes degrade together
+    (`fifo_baseline_degrades` — the contrast the report asserts)."""
+    return Scenario.from_dict({
+        "name": f"soak-multi-tenant-{sched_policy}",
+        "duration_s": 60.0,
+        "rps": 400.0,  # ~2x the capacity model's single-replica knee
+        "deadline_s": 0.25,
+        "window_s": 5.0,
+        "seed": 4242,
+        "replicas": 1,
+        "tls": False,
+        "constraints": 30,
+        "external_keys": 12,
+        "window_ms": 10.0,
+        "min_device_batch": 2,
+        # scheduling is a validation/mutation-plane story here; agent
+        # traffic would add a second tenant-identity axis to the split
+        "planes": {"validation": 0.85, "mutation": 0.15},
+        "sched_policy": sched_policy,
+        "tenants": {
+            "noisy_fraction": 0.75,
+            "quiet_ns": "ns-quiet",
+            "noisy_ns": "ns-noisy",
+        },
+        "events": [
+            {"at": 0.0, "action": "phase", "name": "overload"},
+        ],
+    })
+
+
+def multi_tenant_smoke_scenario(
+    sched_policy: str = "deadline",
+) -> Scenario:
+    """Tier-1 smoke of the multi-tenant overload machinery (~8 s, one
+    replica): small corpus, overdriven arrivals, the same two-tenant
+    mix — enough to exercise the scheduler seams, the per-class
+    sampler columns, and the report checks without asserting the full
+    run's attainment numbers."""
+    return Scenario.from_dict({
+        "name": f"soak-multi-tenant-smoke-{sched_policy}",
+        "duration_s": 8.0,
+        "rps": 120.0,
+        "deadline_s": 0.3,
+        "window_s": 1.0,
+        "seed": 77,
+        "replicas": 1,
+        "tls": False,
+        "constraints": 8,
+        "external_keys": 5,
+        "planes": {"validation": 0.85, "mutation": 0.15},
+        "sched_policy": sched_policy,
+        "tenants": {
+            "noisy_fraction": 0.75,
+            "quiet_ns": "ns-quiet",
+            "noisy_ns": "ns-noisy",
+        },
+        "events": [
+            {"at": 0.0, "action": "phase", "name": "overload"},
         ],
     })
 

@@ -2,7 +2,7 @@
 
 Every bench entry point (`bench_webhook.py --ladder/--attribution/
 --partitions/--fleet/--chaos/--churn/--external/--mutate/--soak/
---slo`, `bench.py`)
+--slo/--sched`, `bench.py`)
 ends its run with one compact driver-parseable line:
 
     SUMMARY: {"mode": "<lane>", ...headline numbers...}
@@ -73,6 +73,16 @@ REQUIRED_FIELDS: Dict[str, tuple] = {
     "slo": (
         "slo_attainment", "saturation", "burn_rate_fast",
         "headroom_rps", "breaches",
+    ),
+    # the admission-scheduler lane (gatekeeper_tpu/sched/): the same
+    # two-tenant overload through FIFO then the deadline scheduler —
+    # per-class latency/attainment split, the worst per-tenant
+    # attainment (bench_compare watches it down-bad), and predictive
+    # (predicted_miss) vs blind (FIFO queue_full) shed counts
+    "sched": (
+        "quiet_p50_ms", "quiet_p99_ms", "noisy_p50_ms", "noisy_p99_ms",
+        "quiet_attainment", "noisy_attainment", "tenant_attainment_min",
+        "predicted_miss_shed", "blind_shed",
     ),
 }
 

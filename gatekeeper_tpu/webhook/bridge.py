@@ -195,6 +195,12 @@ class BridgeStack:
         metrics=None,
         tracer=None,
         max_queue=None,
+        # admission scheduling (docs/operations.md §Admission
+        # scheduling): the bridge rides the same MicroBatcher seam,
+        # so the deadline policy + fair-share quotas apply here too
+        sched_policy: str = "fifo",
+        slo=None,
+        attributor=None,
         **handler_kwargs,
     ):
         from .namespacelabel import NamespaceLabelHandler
@@ -209,6 +215,7 @@ class BridgeStack:
             metrics=metrics, tracer=tracer,
             max_queue=max_queue if max_queue is not None
             else DEFAULT_MAX_QUEUE,
+            sched_policy=sched_policy, slo=slo, attributor=attributor,
         )
         handler_kwargs.setdefault("metrics", metrics)
         handler_kwargs.setdefault("tracer", tracer)

@@ -36,7 +36,12 @@ from ..faults import (
     fire,
 )
 from ..mutation import ConvergenceError, MutationApplyError, json_patch
-from .policy import SERVICE_ACCOUNT, AdmissionResponse, unavailable_response
+from .policy import (
+    SERVICE_ACCOUNT,
+    AdmissionResponse,
+    note_unavailable_decision,
+    unavailable_response,
+)
 from .server import DEFAULT_MAX_QUEUE, DEFAULT_REQUEST_TIMEOUT, MicroBatcher
 
 # mutators act on the incoming object; DELETE carries none
@@ -67,6 +72,9 @@ class MutateBatcher(MicroBatcher):
         max_queue: Optional[int] = DEFAULT_MAX_QUEUE,
         breaker=None,
         decisions=None,
+        sched_policy: str = "fifo",
+        slo=None,
+        attributor=None,
     ):
         super().__init__(
             client=None,
@@ -79,6 +87,9 @@ class MutateBatcher(MicroBatcher):
             max_queue=max_queue,
             breaker=breaker,
             decisions=decisions,
+            sched_policy=sched_policy,
+            slo=slo,
+            attributor=attributor,
         )
         self.system = system
 
@@ -90,7 +101,7 @@ class MutateBatcher(MicroBatcher):
             return
         wall0, t0 = time.time(), time.perf_counter()
         reviews = []
-        for request, _, _, _, _ in batch:
+        for request, _, _, _, _, _ in batch:
             review = dict(request)
             ns_obj = None
             namespace = request.get("namespace", "")
@@ -138,7 +149,7 @@ class MutateBatcher(MicroBatcher):
                 # answers with the endpoint's fail policy (the apiserver
                 # would admit unmutated on webhook failure too — here it
                 # is explicit and counted). NEVER a half-screened batch.
-                for _, fut, ctx, (sub_wall, _sp), _ in batch:
+                for _, fut, ctx, (sub_wall, _sp), _, _ in batch:
                     fut.set_exception(EvaluationUnavailable(str(e)))
                     self._record_mutate_spans(
                         ctx, sub_wall, wall0, wall0, 0.0, 0.0, 0.0,
@@ -155,9 +166,9 @@ class MutateBatcher(MicroBatcher):
             self.metrics.observe("mutation_screen_batch_size", len(batch))
 
         wall_scr_end = wall0 + (time.perf_counter() - t0)
-        for i, ((request, fut, ctx, (sub_wall, _), _dl), review) in enumerate(
-            zip(batch, reviews)
-        ):
+        for i, (
+            (request, fut, ctx, (sub_wall, _), _dl, _tk), review
+        ) in enumerate(zip(batch, reviews)):
             selected = [m for j, m in enumerate(muts) if matrix[j, i]]
             obj = review.get("object")
             apply_s = render_s = 0.0
@@ -299,7 +310,11 @@ class MutationHandler:
             resource_name=request.get("name", ""),
             operation=request.get("operation", ""),
         ) as span:
-            resp = self._handle(request, span)
+            # shed/unavailable outcomes override the verdict below —
+            # a fail-open shed must NOT be recorded as a healthy allow
+            # (per-tenant shed accounting reads these records)
+            decision: Dict[str, Any] = {}
+            resp = self._handle(request, span, decision)
             span.set_attr(
                 mutation_status=(
                     "error"
@@ -325,9 +340,12 @@ class MutationHandler:
                 mutation_status=status,
             )
         if self.decision_log is not None:
+            verdict = decision.pop("verdict", None) or (
+                "allow" if resp.allowed else "error"
+            )
             self.decision_log.record_decision(
                 "mutation",
-                "allow" if resp.allowed else "error",
+                verdict,
                 code=resp.code,
                 trace_id=getattr(span, "trace_id", None) or trace_id,
                 duration_ms=duration_s * 1e3,
@@ -343,10 +361,13 @@ class MutationHandler:
                 ),
                 mutation_status=status,
                 patch_ops=len(resp.patch or []),
+                **decision,
             )
         return resp
 
-    def _handle(self, request: Dict[str, Any], span=None) -> AdmissionResponse:
+    def _handle(
+        self, request: Dict[str, Any], span=None, decision=None
+    ) -> AdmissionResponse:
         from ..control import PROCESS_WEBHOOK
 
         user = (request.get("userInfo") or {}).get("username", "")
@@ -366,11 +387,14 @@ class MutationHandler:
                 True, "Namespace is set to be ignored by Gatekeeper config"
             )
         # deadline propagation: the request's remaining budget rides to
-        # the batch worker so expiry is checked BEFORE the screen
+        # the batch worker so expiry is checked BEFORE the screen; the
+        # tenant identity rides too (extracted BEFORE enqueue so shed
+        # accounting and fair-share quotas key on it)
         deadline = self.batcher._now() + self.request_timeout
         fut = self.batcher.submit(
             request, span_ctx=getattr(span, "context", None),
             deadline=deadline,
+            tenant={"namespace": namespace, "username": user},
         )
         try:
             try:
@@ -395,6 +419,8 @@ class MutationHandler:
             # shed / expired / every screen rung down: the fail-policy
             # envelope (fail-open admits UNMUTATED — exactly what the
             # apiserver's failurePolicy: Ignore would do on timeout)
+            if decision is not None:
+                note_unavailable_decision(decision, e)
             return unavailable_response(
                 e, fail_policy=self.fail_policy, metrics=self.metrics,
                 log=self.log, span=span, plane="mutation",

@@ -147,6 +147,30 @@ def unavailable_response(
     )
 
 
+# the shed reasons recorded with verdict "shed" (vs "unavailable"):
+# queue_full / predictive-miss / tenant-quota sheds and deadline expiry
+# all mean "dropped by the admission plane", not "every rung down"
+SHED_REASONS = ("queue_full", "deadline", "predicted_miss", "tenant_capped")
+
+
+def note_unavailable_decision(
+    decision: Dict[str, Any], e: AdmissionUnavailable
+) -> None:
+    """Stamp the typed not-evaluated outcome into a handler's decision
+    dict (shared by the validation / mutation / agent planes): the
+    verdict, the shed reason, and — for predictive sheds — the negative
+    predicted slack and whether the tenant was over its fair share."""
+    decision["verdict"] = (
+        "shed" if e.reason in SHED_REASONS else "unavailable"
+    )
+    decision["reason"] = e.reason
+    slack = getattr(e, "predicted_slack_ms", None)
+    if slack is not None:
+        decision["predicted_slack_ms"] = round(slack, 3)
+    if getattr(e, "tenant_capped", False):
+        decision["tenant_capped"] = True
+
+
 class ValidationHandler:
     def __init__(
         self,
@@ -364,11 +388,7 @@ class ValidationHandler:
             # degraded / timeout) are first-class in the decision
             # stream — an overload story must be reconstructible from
             # the records alone
-            decision["verdict"] = (
-                "shed" if e.reason in ("queue_full", "deadline")
-                else "unavailable"
-            )
-            decision["reason"] = e.reason
+            note_unavailable_decision(decision, e)
             return self._unavailable_response(e, span)
         except Exception as e:
             return AdmissionResponse(False, str(e), code=500)

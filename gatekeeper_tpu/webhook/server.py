@@ -127,6 +127,15 @@ class MicroBatcher:
         # the handler claims them when it records the decision
         # (docs/observability.md §Decision log)
         decisions=None,
+        # admission scheduling (docs/operations.md §Admission
+        # scheduling): "fifo" is bit-compatible with the pre-scheduler
+        # queue; "deadline" turns on EDF batch formation, predictive
+        # shedding, and per-tenant fair-share quotas. slo (SloEngine)
+        # feeds the overload/saturation loop and the batch cost EWMA;
+        # attributor seeds the cost model before the EWMA warms.
+        sched_policy: str = "fifo",
+        slo=None,
+        attributor=None,
     ):
         self.client = client
         self.target = target
@@ -164,10 +173,25 @@ class MicroBatcher:
                 recorder=recorder,
             )
         self.breaker: Optional[CircuitBreaker] = breaker or None
+        # the admission scheduler owns enqueue-side admit/shed and the
+        # dispatch-side batch cut; its clock is the batcher's skewed
+        # deadline clock so chaos clock jumps steer it too
+        from ..sched import AdmissionScheduler
+
+        self.sched = AdmissionScheduler(
+            plane=self.plane,
+            policy=sched_policy,
+            max_queue=max_queue,
+            clock=self._now,
+            slo=slo,
+            attributor=attributor,
+            metrics=metrics,
+        )
         # (request, future, span ctx | None, (wall, perf) submit stamp,
-        #  monotonic deadline | None)
+        #  monotonic deadline | None, scheduler tenant key | None)
         self._pending: List[
-            Tuple[Dict[str, Any], Future, Any, Tuple, Optional[float]]
+            Tuple[Dict[str, Any], Future, Any, Tuple, Optional[float],
+                  Optional[str]]
         ] = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -305,7 +329,7 @@ class MicroBatcher:
             # members — the request-level share of what the constraint-
             # level CostAttributor accounts exactly
             base["device_seconds_share"] = round(dev / len(batch), 9)
-        for i, (_, _, ctx, _, _) in enumerate(batch):
+        for i, (_, _, ctx, _, _, _) in enumerate(batch):
             tid = getattr(ctx, "trace_id", None)
             if tid is None:
                 continue
@@ -353,11 +377,14 @@ class MicroBatcher:
         fut.set_exception(exc)
 
     def submit(self, request: Dict[str, Any], span_ctx=None,
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None, tenant=None) -> Future:
         """Enqueue for the next fused dispatch. `deadline` is a
         monotonic timestamp (the caller's remaining budget): a request
         that is already expired — or expires while queued — is shed
-        with DeadlineExceeded instead of ever reaching a dispatch."""
+        with DeadlineExceeded instead of ever reaching a dispatch.
+        `tenant` is the decision-log tenant identity, extracted BEFORE
+        enqueue so shed verdicts carry it and the scheduler's
+        fair-share quotas account exactly."""
         fut: Future = Future()
         stamp = (time.time(), time.perf_counter())
         if deadline is not None and self._now() >= deadline:
@@ -369,31 +396,42 @@ class MicroBatcher:
                 "deadline", ctx=span_ctx, sub_wall=stamp[0],
             )
             return fut
-        overloaded = False
+        shed_exc = victim_item = victim_exc = None
         with self._lock:
             stopped = self._stop
             if not stopped:
-                if (
-                    self.max_queue is not None
-                    and len(self._pending) >= self.max_queue
-                ):
-                    overloaded = True
-                else:
+                key, shed_exc, victim = self.sched.offer(
+                    self._pending, tenant=tenant, deadline=deadline,
+                    now=self._now(),
+                )
+                if shed_exc is None:
+                    if victim is not None:
+                        # predictive shedding under a full queue: the
+                        # queued request that provably cannot make its
+                        # deadline goes, not the viable newcomer
+                        idx, victim_exc = victim
+                        victim_item = self._pending.pop(idx)
                     self._pending.append(
-                        (request, fut, span_ctx, stamp, deadline)
+                        (request, fut, span_ctx, stamp, deadline, key)
                     )
                     n = len(self._pending)
         if stopped:
             # worker is gone (and stop() may have already drained its
             # leftovers): dispatch inline so the caller never hangs
-            self._dispatch([(request, fut, span_ctx, stamp, deadline)])
-        elif overloaded:
+            self._dispatch(
+                [(request, fut, span_ctx, stamp, deadline, None)]
+            )
+            return fut
+        if victim_item is not None:
+            _, vfut, vctx, vstamp, _, _ = victim_item
             self._shed(
-                fut,
-                ShedError(
-                    f"admission queue full ({self.max_queue} pending)"
-                ),
-                "queue_full", ctx=span_ctx, sub_wall=stamp[0],
+                vfut, victim_exc, victim_exc.reason,
+                ctx=vctx, sub_wall=vstamp[0],
+            )
+        if shed_exc is not None:
+            self._shed(
+                fut, shed_exc, getattr(shed_exc, "reason", "queue_full"),
+                ctx=span_ctx, sub_wall=stamp[0],
             )
         else:
             if self.metrics is not None:
@@ -424,11 +462,20 @@ class MicroBatcher:
                 self._wake.wait(remaining)
                 self._wake.clear()
             with self._lock:
-                batch = self._pending
-                self._pending = []
+                # the scheduler cuts the batch: fifo takes everything
+                # in arrival order (the pre-scheduler swap); deadline
+                # policy orders EDF and defers requests that would blow
+                # the earliest member deadline to the next window
+                batch, rest = self.sched.cut(
+                    self._pending, self.max_batch, now=self._now()
+                )
+                self._pending = rest
+            if rest:
+                # deferred work exists: start the next window now
+                self._wake.set()
             if self.metrics is not None:
                 self.metrics.gauge(
-                    "admission_queue_depth", 0, plane=self.plane
+                    "admission_queue_depth", len(rest), plane=self.plane
                 )
             if batch:
                 self._dispatch(batch)
@@ -442,7 +489,7 @@ class MicroBatcher:
         now = self._now()
         live = []
         for item in batch:
-            _, fut, ctx, stamp, deadline = item
+            _, fut, ctx, stamp, deadline = item[:5]
             if deadline is not None and now >= deadline:
                 self._shed(
                     fut,
@@ -464,7 +511,7 @@ class MicroBatcher:
             self.target_handler.augment_request(
                 request, self.namespace_getter
             )
-            for request, _, _, _, _ in batch
+            for request, _, _, _, _, _ in batch
         ]
         if self.partitioner is not None:
             plan = None
@@ -526,7 +573,7 @@ class MicroBatcher:
                 self._liveness_skipped_count() - skip0
             ),
         )
-        for (_, fut, _, _, _), responses in zip(batch, all_responses):
+        for (_, fut, _, _, _, _), responses in zip(batch, all_responses):
             resp = responses.by_target.get(self.target)
             fut.set_result(resp.results if resp is not None else [])
 
@@ -780,7 +827,7 @@ class MicroBatcher:
                     self._liveness_skipped_count() - skip0
                 ),
             )
-        for i, (_, fut, _, _, _) in enumerate(batch):
+        for i, (_, fut, _, _, _, _) in enumerate(batch):
             if i in errors:
                 fut.set_exception(errors[i])
             else:
@@ -804,7 +851,7 @@ class MicroBatcher:
         try:
             fire("webhook.host_review")
         except FaultError as e:
-            for _, fut, _, _, _ in batch:
+            for _, fut, _, _, _, _ in batch:
                 fut.set_exception(EvaluationUnavailable(str(e)))
             self._record_spans(batch, wall0, t0, route="unavailable")
             self._note_decisions(batch, "unavailable")
@@ -824,7 +871,7 @@ class MicroBatcher:
         if host is None:
             host = self.client.review
         fetch0 = self._extdata_fetch_count()
-        for review, (_, fut, _, _, _) in zip(reviews, batch):
+        for review, (_, fut, _, _, _, _) in zip(reviews, batch):
             try:
                 responses = host(review)
                 resp = responses.by_target.get(self.target)
@@ -864,7 +911,7 @@ class MicroBatcher:
                 if k in stats:
                     attrs[k] = stats[k]
         render_s = phases.get("render", 0.0)
-        for _, _, ctx, (sub_wall, _sub_perf), _ in batch:
+        for _, _, ctx, (sub_wall, _sub_perf), _, _ in batch:
             if ctx is None:
                 continue
             self.tracer.record_span(
@@ -916,9 +963,19 @@ class BatchedValidationHandler(ValidationHandler):
             return super()._review(request, tracing=True, span=span)
         ctx = getattr(span, "context", None)
         # deadline propagation: the request's remaining budget rides to
-        # the batch worker so expiry is checked BEFORE dispatch
+        # the batch worker so expiry is checked BEFORE dispatch. Tenant
+        # identity is extracted BEFORE enqueue too — shed verdicts must
+        # carry it, and the scheduler's quotas key on it.
         deadline = self.batcher._now() + self.request_timeout
-        fut = self.batcher.submit(request, span_ctx=ctx, deadline=deadline)
+        tenant = {
+            "namespace": request.get("namespace", ""),
+            "username": (request.get("userInfo") or {}).get(
+                "username", ""
+            ),
+        }
+        fut = self.batcher.submit(
+            request, span_ctx=ctx, deadline=deadline, tenant=tenant
+        )
         try:
             return fut.result(timeout=self.request_timeout)
         except _FutureTimeout:
@@ -1011,6 +1068,13 @@ class WebhookServer:
         # analysis.corpus.CorpusPlane: feeds the partition planner its
         # provably-dead (verdict-safe prunable) constraint keys
         corpus=None,
+        # admission scheduling (docs/operations.md §Admission
+        # scheduling): policy for every plane's batcher — "fifo" is the
+        # bit-compatible rollback path, "deadline" enables EDF batch
+        # formation + predictive shedding + fair-share quotas. slo is
+        # the obs.SloEngine feeding the overload/saturation loop.
+        sched_policy: str = "fifo",
+        slo=None,
     ):
         self.client = client  # warmup() compiles through it
         self.tracer = tracer
@@ -1052,6 +1116,9 @@ class WebhookServer:
             partitioner=self.partitioner,
             recorder=recorder,
             decisions=decision_log,
+            sched_policy=sched_policy,
+            slo=slo,
+            attributor=attributor,
         )
         self.mutate_batcher = None
         self.mutation_handler = None
@@ -1065,6 +1132,9 @@ class WebhookServer:
                 metrics=metrics, tracer=tracer,
                 max_queue=max_queue,
                 decisions=decision_log,
+                sched_policy=sched_policy,
+                slo=slo,
+                attributor=attributor,
             )
             self.mutation_handler = MutationHandler(
                 self.mutate_batcher,
@@ -1110,6 +1180,9 @@ class WebhookServer:
                 request_timeout=request_timeout,
                 max_queue=max_queue,
                 decision_log=decision_log,
+                sched_policy=sched_policy,
+                slo=slo,
+                attributor=attributor,
             )
         outer = self
 
@@ -1306,6 +1379,21 @@ class WebhookServer:
                 pass  # warmup is best-effort; serving works unwarmed
         self.warm = True
         return time.monotonic() - t0
+
+    def sched_snapshot(self) -> Dict[str, Any]:
+        """Per-plane admission-scheduler state: the `/readyz`
+        `stats.sched` and `/debug/sched` document (docs/operations.md
+        §Admission scheduling)."""
+        out: Dict[str, Any] = {"validation": self.batcher.sched.snapshot()}
+        if self.mutate_batcher is not None:
+            out["mutation"] = self.mutate_batcher.sched.snapshot()
+        if self.agent_batcher is not None:
+            out["agent"] = self.agent_batcher.sched.snapshot()
+        if self.agent_mutate_batcher is not None:
+            out["agent_mutation"] = (
+                self.agent_mutate_batcher.sched.snapshot()
+            )
+        return out
 
     # -- graceful drain (docs/robustness.md) ---------------------------------
 

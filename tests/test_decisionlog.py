@@ -587,3 +587,61 @@ def test_shed_decisions_recorded_with_typed_verdict():
     )
     assert not serial.handle(ns_request(1, "ns-b")).allowed
     assert decisions.records(verdict="deny", plane="validation")
+
+
+def test_shed_decisions_carry_tenant_for_exact_accounting():
+    """Regression (the scheduler PR's decision-record fix): the tenant
+    identity is extracted BEFORE enqueue, so a queue-full shed record
+    still names its tenant — on the validation AND mutation planes —
+    and `tenant_stats()` counts the shed against that tenant exactly."""
+    from gatekeeper_tpu.mutation import MutationSystem
+    from gatekeeper_tpu.webhook import MutateBatcher, MutationHandler
+
+    client = build_ns_client()
+    decisions = DecisionLog(allow_sample_n=0, max_per_s=0)
+    batcher = MicroBatcher(
+        client, TARGET, window_ms=5.0, max_queue=0,
+        decisions=decisions,
+    )
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=1.0, fail_policy="open",
+        decision_log=decisions,
+    )
+    # no batcher.start(): max_queue=0 sheds at submit
+    assert handler.handle(ns_request(0, "ns-a")).allowed
+    rec = decisions.records(verdict="shed", plane="validation")[0]
+    assert rec["reason"] == "queue_full"
+    assert rec["tenant"] == {"namespace": "ns-a", "username": "alice"}
+
+    mut = MutateBatcher(
+        MutationSystem(), window_ms=5.0, max_queue=0,
+        decisions=decisions,
+    )
+    mhandler = MutationHandler(
+        mut, request_timeout=1.0, decision_log=decisions,
+    )
+    body = {
+        "uid": "uid-m0",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": "CREATE",
+        "name": "p0",
+        "namespace": "ns-b",
+        "userInfo": {"username": "alice"},
+        "object": {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p0", "namespace": "ns-b"},
+            "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+        },
+    }
+    mhandler.handle(body)
+    mrec = decisions.records(verdict="shed", plane="mutation")[0]
+    assert mrec["reason"] == "queue_full"
+    assert mrec["tenant"]["namespace"] == "ns-b"
+
+    # exact per-tenant accounting: each shed landed on its tenant key
+    stats = decisions.tenant_stats()
+    assert stats["validation/ns-a"]["shed"] == 1
+    assert stats["mutation/ns-b"]["shed"] == 1
+    for key in ("validation/ns-a", "mutation/ns-b"):
+        assert stats[key]["count"] == 1
+        assert stats[key]["attainment"] == 0.0

@@ -1,0 +1,552 @@
+"""Admission scheduler unit battery (gatekeeper_tpu/sched/): EDF batch
+formation, fair-share quota arithmetic, predictive-shed boundary cases,
+and the FIFO-policy bit-compatibility guarantee — all on an injected
+clock with a fake cost model, so every decision is deterministic.
+
+Plus the two integration seams the unit surface cannot pin:
+  * a predictive shed travels the MicroBatcher -> handler -> decision
+    log path with its typed reason and negative predicted slack;
+  * admitted verdicts are identical between `fifo` and `deadline`
+    policies (the scheduler reorders and sheds — it never changes
+    evaluation).
+"""
+
+import pytest
+
+from gatekeeper_tpu.faults import ShedError
+from gatekeeper_tpu.metrics import MetricsRegistry
+from gatekeeper_tpu.sched import (
+    POLICIES,
+    AdmissionScheduler,
+    BatchCostModel,
+    TokenBucket,
+    export_sched,
+    fair_shares,
+)
+
+pytestmark = pytest.mark.sched
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+
+class FakeSlo:
+    """Injected autoscaler signal (the scheduler's only SLO seam)."""
+
+    def __init__(self, saturation=0.0, headroom=100.0, arrival=10.0,
+                 burning=False, cost=None):
+        self.saturation = saturation
+        self.headroom = headroom
+        self.arrival = arrival
+        self.burning = burning
+        self.cost = cost
+
+    def autoscaler(self):
+        return {
+            "saturation": self.saturation,
+            "burning": self.burning,
+            "estimated_headroom_rps": self.headroom,
+            "arrival_rps": self.arrival,
+        }
+
+    def cost_per_row(self):
+        return self.cost
+
+
+def item(deadline=None, tenant=None):
+    """A pending-queue tuple: the scheduler reads only indices 4/5."""
+    return ("req", "fut", None, (0.0, 0.0), deadline, tenant)
+
+
+def make_sched(policy="deadline", clock_box=None, per_row=0.1, **kw):
+    clock_box = clock_box if clock_box is not None else [0.0]
+    kw.setdefault("cost_model", BatchCostModel(per_row_fn=lambda: per_row))
+    return AdmissionScheduler(
+        plane="validation", policy=policy,
+        clock=lambda: clock_box[0], **kw
+    )
+
+
+# -- fair shares + token bucket ----------------------------------------------
+
+
+def test_fair_shares_water_filling_exact():
+    # capacity 100 over demands 10/20/200: light tenants keep their
+    # demand, the heavy one absorbs the surplus
+    shares = fair_shares({"a": 10.0, "b": 20.0, "c": 200.0}, 100.0)
+    assert shares == {"a": 10.0, "b": 20.0, "c": 70.0}
+    # two heavy tenants split the remainder evenly
+    shares = fair_shares({"a": 10.0, "b": 500.0, "c": 500.0}, 100.0)
+    assert shares == {"a": 10.0, "b": 45.0, "c": 45.0}
+    # deterministic tie-break by key, floor applied after the split
+    shares = fair_shares({"b": 0.0, "a": 0.0}, 10.0, floor=1.0)
+    assert shares == {"a": 1.0, "b": 1.0}
+    assert fair_shares({}, 100.0) == {}
+    # zero capacity: everyone gets the floor only
+    shares = fair_shares({"a": 5.0}, 0.0, floor=0.5)
+    assert shares == {"a": 0.5}
+
+
+def test_token_bucket_refill_and_bounded_debt():
+    b = TokenBucket(rate_rps=2.0, now=0.0)  # burst = 2 rps * 2 s = 4
+    assert b.burst == 4.0 and b.tokens == 4.0
+    for _ in range(4):
+        assert b.take(0.0)
+    assert not b.take(0.0)  # empty: charged anyway, now in debt
+    assert b.tokens == -1.0
+    # debt clamps at one burst window even under a storm
+    for _ in range(50):
+        b.take(0.0)
+    assert b.tokens == -4.0
+    # refill arithmetic: 1.5 s at 2 rps = +3 tokens from the debt floor
+    b.take(1.5, n=0.0)
+    assert b.tokens == pytest.approx(-1.0)
+    # a long quiet period refills to burst, never beyond
+    assert b.take(100.0)
+    assert b.tokens == pytest.approx(3.0)
+    # rate floor: a zero-share tenant still trickles
+    b.set_rate(0.0)
+    assert b.rate == pytest.approx(1e-3)
+
+
+def test_cost_model_resolution_order():
+    slo = FakeSlo(cost=0.02)
+
+    class Att:
+        dispatches = 10
+        total_seconds = 64.0  # 6.4 s/dispatch over 64 nominal rows
+
+    m = BatchCostModel(slo=slo, attributor=Att(),
+                       per_row_fn=lambda: 0.5)
+    assert m.per_row_seconds() == 0.5          # injected fn wins
+    m = BatchCostModel(slo=slo, attributor=Att())
+    assert m.per_row_seconds() == 0.02          # live SLO EWMA next
+    m = BatchCostModel(slo=FakeSlo(cost=None), attributor=Att())
+    assert m.per_row_seconds() == pytest.approx(0.1)  # static amortized
+    m = BatchCostModel()
+    assert m.per_row_seconds() == pytest.approx(2e-4)  # cold start
+    assert m.predict(10) == pytest.approx(2e-3)
+    assert m.predict(-5) == 0.0
+
+
+# -- the enqueue-side decision ------------------------------------------------
+
+
+def test_fifo_policy_is_bit_compatible():
+    """The rollback path: exact legacy shed message, newest-arrival
+    drop, no victims, and NO sched_* metric series."""
+    metrics = MetricsRegistry()
+    s = make_sched(policy="fifo", max_queue=2, metrics=metrics)
+    pending = [item(), item()]
+    key, shed, victim = s.offer(pending, tenant={"namespace": "ns1"})
+    assert key == "ns1"
+    assert victim is None
+    assert isinstance(shed, ShedError)
+    assert str(shed) == "admission queue full (2 pending)"
+    assert shed.reason == "queue_full"
+    key, shed, victim = s.offer([], tenant={"namespace": "ns1"})
+    assert shed is None and victim is None
+    assert s.admitted == 1
+    # FIFO cut: everything, arrival order, even past-deadline items
+    batch, rest = s.cut(pending, max_batch=64)
+    assert batch == pending and rest == []
+    snap = metrics.snapshot()
+    for family in snap.values():
+        if isinstance(family, dict):
+            assert not any(k.startswith("sched_") for k in family)
+
+
+def test_unloaded_plane_admits_exactly_like_fifo():
+    """Quota caps and predictive shedding engage ONLY while the plane
+    is overloaded: with saturation under the threshold even a
+    provably-late request admits."""
+    clock = [0.0]
+    s = make_sched(clock_box=clock, slo=FakeSlo(saturation=0.2),
+                   max_queue=8)
+    # deadline already unmakeable (predict(1)=0.1 > 0.05 slack)
+    key, shed, victim = s.offer(
+        [], tenant={"namespace": "ns1"}, deadline=0.05
+    )
+    assert shed is None and victim is None
+    assert s.snapshot()["overloaded"] is False
+
+
+def test_predictive_shed_boundary_cases():
+    clock = [100.0]
+    # a generous min share so the quota plane stays out of the way —
+    # this test isolates the predictive-shed arithmetic
+    s = make_sched(clock_box=clock, slo=FakeSlo(saturation=0.95),
+                   max_queue=64, min_share_rps=1000.0)
+    pending = [item(deadline=200.0)] * 4
+    # predict(5) = 0.5 s; slack exactly 0 -> ADMIT (only provable
+    # misses are shed)
+    key, shed, victim = s.offer(
+        pending, tenant={"namespace": "ns1"}, deadline=100.5
+    )
+    assert shed is None
+    # one tick less: negative slack -> predicted_miss with the slack
+    key, shed, victim = s.offer(
+        pending, tenant={"namespace": "ns1"}, deadline=100.4999
+    )
+    assert isinstance(shed, ShedError)
+    assert shed.reason == "predicted_miss"
+    assert shed.predicted_slack_ms < 0
+    assert victim is None
+    # no deadline -> nothing to predict -> admit
+    key, shed, victim = s.offer(pending, tenant={"namespace": "ns1"})
+    assert shed is None
+    snap = s.snapshot()
+    assert snap["overloaded"] is True
+    assert snap["sheds"]["predicted_miss"] == 1
+    assert snap["tenants"]["ns1"]["shed"] == 1
+    assert snap["tenants"]["ns1"]["admitted"] == 2
+
+
+def test_full_queue_evicts_doomed_victim_not_viable_newcomer():
+    clock = [100.0]
+    s = make_sched(clock_box=clock, max_queue=4)
+    doomed = item(deadline=100.01, tenant="ns-doomed")  # slack -390 ms
+    pending = [
+        doomed,
+        item(deadline=100.9, tenant="a"),
+        item(deadline=101.0, tenant="b"),
+        item(tenant="c"),  # no deadline: never a victim
+    ]
+    # viable newcomer (predict(5)=0.5 -> done 100.5 < dl 100.9)
+    key, shed, victim = s.offer(
+        pending, tenant={"namespace": "ns-new"}, deadline=100.9
+    )
+    assert shed is None
+    assert victim is not None
+    idx, vexc = victim
+    assert pending[idx] is doomed
+    assert vexc.reason == "predicted_miss"
+    assert vexc.predicted_slack_ms < 0
+    assert s.snapshot()["sheds"]["predicted_miss"] == 1
+    # all queued viable -> the newcomer sheds queue_full instead
+    viable = [item(deadline=200.0, tenant="a")] * 4
+    key, shed, victim = s.offer(
+        viable, tenant={"namespace": "ns-new"}, deadline=200.0
+    )
+    assert victim is None
+    assert isinstance(shed, ShedError)
+    assert shed.reason == "queue_full"
+    assert str(shed) == "admission queue full (4 pending)"
+
+
+def test_tenant_capped_only_while_overloaded():
+    metrics = MetricsRegistry()
+    clock = [0.0]
+    slo = FakeSlo(saturation=0.95, headroom=0.0, arrival=0.0)
+    s = make_sched(clock_box=clock, slo=slo, max_queue=64,
+                   metrics=metrics)
+    # new tenant bucket: rate = min_share 1 rps, burst 2 tokens
+    tenant = {"namespace": "hog"}
+    assert s.offer([], tenant=tenant)[1] is None
+    assert s.offer([], tenant=tenant)[1] is None
+    key, shed, victim = s.offer([], tenant=tenant)  # bucket empty
+    assert isinstance(shed, ShedError)
+    assert shed.reason == "tenant_capped"
+    assert shed.tenant_capped is True
+    snap = s.snapshot()
+    assert snap["sheds"]["tenant_capped"] == 1
+    assert snap["tenants"]["hog"]["throttled"] == 1
+    assert snap["tenants"]["hog"]["tokens"] < 0
+    counters = metrics.snapshot()["counters"]
+    assert any(
+        k.startswith("sched_tenant_throttled_total") for k in counters
+    )
+    assert any(
+        k.startswith("sched_shed_total") and 'reason="tenant_capped"' in k
+        for k in counters
+    )
+    # same exhaustion WITHOUT overload: charged but admitted
+    s2 = make_sched(clock_box=[0.0], slo=FakeSlo(saturation=0.1),
+                    max_queue=64)
+    for _ in range(5):
+        assert s2.offer([], tenant=tenant)[1] is None
+    assert s2.snapshot()["tenants"]["hog"]["tokens"] < 0
+
+
+def test_requota_is_max_min_fair_over_live_headroom():
+    """Active tenants' bucket rates converge to the max-min fair split
+    of arrival+headroom, never below the even split or the floor."""
+    clock = [0.0]
+    slo = FakeSlo(saturation=0.95, headroom=60.0, arrival=40.0)
+    s = make_sched(clock_box=clock, slo=slo, max_queue=64)
+    for t in ("a", "b"):
+        s.offer([], tenant={"namespace": t})
+    # cross the requota interval; capacity 100 over two tenants
+    clock[0] = 1.5
+    s.offer([], tenant={"namespace": "a"})
+    snap = s.snapshot()
+    # enforcement cap is >= the even split (50 each) for both tenants
+    assert snap["tenants"]["a"]["share_rps"] >= 50.0
+    assert snap["tenants"]["b"]["share_rps"] >= 50.0
+
+
+def test_tenant_key_identity():
+    tk = AdmissionScheduler.tenant_key
+    assert tk({"namespace": "ns1", "username": "u"}) == "ns1"
+    assert tk({"username": "u"}) == "u"
+    assert tk({"agent": "planner", "session": "s1"}) == "planner/s1"
+    assert tk({"agent": "planner"}) == "planner"
+    assert tk(None) is None
+    assert tk({}) is None
+    assert tk("raw") == "raw"
+
+
+def test_classify_deadline_classes():
+    s = make_sched()
+    assert s.classify(None, 0.0) == "none"
+    assert s.classify(1.5, 0.0) == "urgent"
+    assert s.classify(2.0, 0.0) == "urgent"  # boundary: slack <= 2 s
+    assert s.classify(2.1, 0.0) == "standard"
+
+
+# -- the dispatch-side decision ----------------------------------------------
+
+
+def test_cut_orders_edf_and_respects_earliest_deadline():
+    metrics = MetricsRegistry()
+    clock = [0.0]
+    s = make_sched(clock_box=clock, metrics=metrics)  # per_row 0.1
+    nodl = item(tenant="d")
+    pending = [
+        nodl, item(deadline=5.0, tenant="b"),
+        item(deadline=0.35, tenant="a"), item(deadline=10.0, tenant="c"),
+    ]
+    batch, rest = s.cut(pending, max_batch=64, now=0.0)
+    # EDF prefix: the 4th row would predict 0.4 s > the 0.35 s earliest
+    # deadline, so the no-deadline item defers to the next window
+    assert [it[4] for it in batch] == [0.35, 5.0, 10.0]
+    assert rest == [nodl]
+    snap = s.snapshot()
+    assert snap["cuts"] == 1
+    assert snap["last_cut"] == {
+        "size": 3,
+        "predicted_seconds": pytest.approx(0.3),
+        "deferred": 1,
+    }
+    msnap = metrics.snapshot()
+    assert any(
+        k.startswith("sched_batch_predicted_seconds")
+        for k in msnap["distributions"]
+    )
+    assert any(
+        k.startswith("sched_queue_depth") for k in msnap["gauges"]
+    )
+    # an urgent single-member batch dispatches alone ahead of the rest
+    urgent = item(deadline=0.15, tenant="u")
+    batch, rest = s.cut([nodl, urgent], max_batch=64, now=0.0)
+    assert batch == [urgent] and rest == [nodl]
+    # max_batch caps the prefix
+    many = [item(deadline=100.0) for _ in range(8)]
+    batch, rest = s.cut(many, max_batch=3, now=0.0)
+    assert len(batch) == 3 and len(rest) == 5
+    # empty queue: no-op, no cut counted
+    assert s.cut([], max_batch=8) == ([], [])
+
+
+# -- snapshot + export --------------------------------------------------------
+
+
+def test_snapshot_and_export_sched_filters():
+    s = make_sched(slo=FakeSlo(saturation=0.95))
+    s.offer([], tenant={"namespace": "ns1"}, deadline=100.0)
+    snap = s.snapshot()
+    for k in ("plane", "policy", "overloaded", "saturation",
+              "overload_threshold", "headroom_rps", "arrival_rps",
+              "cost_per_row_s", "admitted", "cuts", "last_cut",
+              "sheds", "tenants"):
+        assert k in snap, k
+    doc = {"validation": snap, "mutation": make_sched().snapshot()}
+    import json
+
+    full = json.loads(export_sched(doc, "/debug/sched"))
+    assert set(full["planes"]) == {"validation", "mutation"}
+    one = json.loads(export_sched(doc, "/debug/sched?plane=validation"))
+    assert set(one["planes"]) == {"validation"}
+    lean = json.loads(export_sched(doc, "/debug/sched?tenants=0"))
+    assert all("tenants" not in p for p in lean["planes"].values())
+    assert "tenants" in full["planes"]["validation"]
+
+
+def test_policy_validation():
+    assert POLICIES == ("fifo", "deadline")
+    with pytest.raises(ValueError):
+        AdmissionScheduler(policy="lifo")
+
+
+# -- integration: batcher -> decision log, and verdict parity -----------------
+
+
+def _ns_client():
+    from gatekeeper_tpu.constraint import (
+        Backend,
+        K8sValidationTarget,
+        TpuDriver,
+    )
+
+    rego = """package reqlabels
+
+violation[{"msg": msg}] {
+    required := {key | key := input.parameters.labels[_]}
+    provided := {key | input.review.object.metadata.labels[key]}
+    missing := required - provided
+    count(missing) > 0
+    msg := sprintf("missing: %v", [missing])
+}
+"""
+    cl = Backend(TpuDriver(use_jax=False)).new_client(
+        K8sValidationTarget()
+    )
+    cl.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "schedlabels"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "SchedLabels"}}},
+            "targets": [{
+                "target": TARGET,
+                "rego": rego.replace("reqlabels", "schedlabels"),
+            }],
+        },
+    })
+    cl.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "SchedLabels",
+        "metadata": {"name": "need-owner"},
+        "spec": {"parameters": {"labels": ["owner"]}},
+    })
+    return cl
+
+
+def _request(i, ns, labels=None):
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"p{i}", "namespace": ns,
+            **({"labels": labels} if labels else {}),
+        },
+        "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+    }
+    return {
+        "uid": f"uid-{i}",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "operation": "CREATE",
+        "name": f"p{i}",
+        "namespace": ns,
+        "userInfo": {"username": "alice"},
+        "object": obj,
+    }
+
+
+def test_predicted_miss_lands_in_decision_log_with_negative_slack():
+    """The acceptance wiring: a predictive shed travels submit ->
+    typed ShedError -> handler -> decision record with verdict='shed',
+    reason='predicted_miss', negative predicted_slack_ms, and the
+    tenant extracted before enqueue."""
+    from gatekeeper_tpu.obs import DecisionLog
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    client = _ns_client()
+    decisions = DecisionLog(allow_sample_n=0, max_per_s=0)
+    slo = FakeSlo(saturation=0.95)
+    batcher = MicroBatcher(
+        client, TARGET, window_ms=5.0, max_queue=8,
+        decisions=decisions, sched_policy="deadline", slo=slo,
+    )
+    # a fake cost model that makes ANY deadline unmakeable
+    batcher.sched.cost = BatchCostModel(per_row_fn=lambda: 10.0)
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=0.5, fail_policy="open",
+        decision_log=decisions,
+    )
+    # no batcher.start(): the shed happens at submit
+    resp = handler.handle(_request(0, "ns-pred"))
+    assert resp.allowed  # fail-open envelope
+    recs = decisions.records(verdict="shed")
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["reason"] == "predicted_miss"
+    assert rec["predicted_slack_ms"] < 0
+    assert rec["tenant"]["namespace"] == "ns-pred"
+    assert batcher.sched.snapshot()["sheds"]["predicted_miss"] == 1
+
+
+def test_admitted_verdicts_identical_fifo_vs_deadline():
+    """The scheduler only reorders and sheds — an admitted request's
+    verdict is byte-identical under either policy."""
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    def run(policy):
+        client = _ns_client()
+        batcher = MicroBatcher(
+            client, TARGET, window_ms=2.0, sched_policy=policy,
+        )
+        handler = BatchedValidationHandler(batcher, request_timeout=10)
+        batcher.start()
+        try:
+            out = []
+            for i in range(8):
+                labels = {"owner": "x"} if i % 2 else None
+                r = handler.handle(_request(i, f"ns{i % 3}", labels))
+                out.append((r.allowed, r.code, r.message))
+            return out
+        finally:
+            batcher.stop()
+
+    assert run("fifo") == run("deadline")
+
+
+def test_multi_tenant_scenarios_and_report_checks():
+    """The soak machinery for the two-tenant overload: scenarios
+    validate and round-trip their scheduler fields, and the report
+    emits the policy-matched contrast check."""
+    from gatekeeper_tpu.soak import (
+        multi_tenant_overload_scenario,
+        multi_tenant_smoke_scenario,
+    )
+    from gatekeeper_tpu.soak.report import build_checks
+
+    for factory in (multi_tenant_overload_scenario,
+                    multi_tenant_smoke_scenario):
+        for policy in POLICIES:
+            scn = factory(sched_policy=policy)
+            scn.validate()
+            d = scn.to_dict()
+            assert d["sched_policy"] == policy
+            assert 0 < d["tenants"]["noisy_fraction"] < 1
+    with pytest.raises(ValueError):
+        multi_tenant_smoke_scenario(sched_policy="lifo").validate()
+
+    def phases(quiet_att, noisy_att, noisy_shed):
+        return [{
+            "phase": "overload", "requests": 1000, "shed": noisy_shed,
+            "attainment": quiet_att, "p99_ms": 100.0, "http_5xx": 0,
+            "conn_errors": 0,
+            "tenant_classes": {
+                "quiet": {"requests": 250, "ok": int(250 * quiet_att),
+                          "shed": 0, "attainment": quiet_att},
+                "noisy": {"requests": 750, "ok": int(750 * noisy_att),
+                          "shed": noisy_shed, "attainment": noisy_att},
+            },
+        }]
+
+    checks = build_checks(
+        phases(0.995, 0.6, 300), {"flagged": []}, [], [],
+        scenario={"sched_policy": "deadline", "deadline_s": 0.25},
+    )
+    assert checks["quiet_tenant_attainment_holds"]["holds"] is True
+    checks = build_checks(
+        phases(0.5, 0.5, 300), {"flagged": []}, [], [],
+        scenario={"sched_policy": "fifo", "deadline_s": 0.25},
+    )
+    assert checks["fifo_baseline_degrades"]["degrades"] is True

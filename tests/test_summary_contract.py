@@ -100,6 +100,21 @@ MODE_RESULTS = {
         "breaches": 1, "burning": False,
         "error_budget_remaining": 0.0,
     },
+    "sched": {
+        "phases": [
+            {"phase": "fifo",
+             "sheds": {"queue_full": 90, "predicted_miss": 0,
+                       "tenant_capped": 0}},
+            {"phase": "deadline",
+             "sheds": {"queue_full": 5, "predicted_miss": 60,
+                       "tenant_capped": 25}},
+        ],
+        "quiet_p50_ms": 12.0, "quiet_p99_ms": 80.0,
+        "noisy_p50_ms": 150.0, "noisy_p99_ms": 240.0,
+        "quiet_attainment": 0.995, "noisy_attainment": 0.62,
+        "tenant_attainment_min": 0.62,
+        "predicted_miss_shed": 60, "blind_shed": 90,
+    },
 }
 
 
@@ -123,7 +138,7 @@ def test_contract_covers_every_bench_mode_flag():
         src = f.read()
     for mode in ("ladder", "attribution", "partitions", "fleet",
                  "chaos", "churn", "external", "mutate", "soak",
-                 "slo"):
+                 "slo", "sched"):
         assert f'"--{mode}"' in src, f"bench flag --{mode} vanished?"
         assert mode in REQUIRED_FIELDS, f"mode {mode!r} unregistered"
     assert "webhook" in REQUIRED_FIELDS  # the default (flagless) lane
