@@ -73,6 +73,12 @@ WATCHED: Dict[str, int] = {
     # scheduler fell back to blind tail-drops
     "tenant_attainment_min": -1,
     "predicted_miss_shed": -1,
+    # verdict-integrity plane (--integrity lane): a rising shadow
+    # divergence rate means fused verdicts drift from the host oracle;
+    # rising canary overhead means the packed rows stopped riding free
+    # padding slots (the ≤3% p50 contract) — both up-bad
+    "divergence_rate": +1,
+    "canary_overhead_frac": +1,
 }
 
 # context keys that make a row's path stable across runs (rungs and

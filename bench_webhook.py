@@ -689,6 +689,139 @@ def run_slo_bench(n_requests=1800, n_constraints=20, err=sys.stderr):
     }
 
 
+def run_integrity_bench(n_requests=1800, n_constraints=20, k=3,
+                        err=sys.stderr):
+    """The `--integrity` lane (docs/robustness.md §Verdict integrity):
+    the verdict-integrity plane through a clean → injected-SDC →
+    self-test-healed cycle on partitioned dispatch. Reports the shadow
+    divergence rate, the canary packing overhead (p50 delta vs the
+    SAME corpus with the plane detached — canaries ride padding slots,
+    so the contract is ≤3%), and the detection latency from arming the
+    device bit-flip to corruption quarantine."""
+    from gatekeeper_tpu.constraint import TpuDriver
+    from gatekeeper_tpu.constraint import tpudriver as _td
+    from gatekeeper_tpu.faults import FAULTS, device_point
+    from gatekeeper_tpu.integrity import IntegrityPlane
+    from gatekeeper_tpu.metrics import MetricsRegistry
+    from gatekeeper_tpu.obs import DecisionLog, FlightRecorder, Tracer
+    from gatekeeper_tpu.parallel.partition import PartitionDispatcher
+    from gatekeeper_tpu.webhook.server import (
+        BatchedValidationHandler,
+        MicroBatcher,
+    )
+
+    metrics = MetricsRegistry()
+    driver = TpuDriver()
+    client = build_chaos_client(driver, n_constraints)
+    tracer = Tracer(max_traces=128)
+    decisions = DecisionLog(metrics=metrics, replica="integrity-bench")
+    recorder = FlightRecorder(
+        tracer=tracer, metrics=metrics, decisions=decisions,
+        replica="integrity-bench",
+    )
+    disp = PartitionDispatcher(
+        client, TARGET, k=k, metrics=metrics,
+        failure_threshold=3, recovery_seconds=1.0,
+    )
+    plane = IntegrityPlane(
+        metrics=metrics, decisions=decisions, recorder=recorder,
+        quarantine_threshold=2, shadow_sample_n=8,
+    )
+    plane.attach_client(client)
+    plane.attach_dispatcher(disp)
+    batcher = MicroBatcher(
+        client, TARGET, window_ms=2.0, metrics=metrics,
+        max_queue=512, partitioner=disp, integrity=plane,
+    )
+    handler = BatchedValidationHandler(
+        batcher, request_timeout=10, metrics=metrics,
+        fail_policy="open", tracer=tracer,
+    )
+    n_sub = max(300, n_requests // 6)
+    phases = []
+
+    def run_phase(name, **extra):
+        r = replay(
+            handler, [make_request(i) for i in range(n_sub)], 64
+        )
+        snap = plane.snapshot()
+        r.update(
+            phase=name,
+            canary_batches=snap["canary"]["batches"],
+            canary_mismatch_batches=snap["canary"]["mismatch_batches"],
+            quarantined=sorted(snap["quarantined"]),
+            **extra,
+        )
+        phases.append(r)
+        print(f"integrity phase: {r}", file=err)
+        return r
+
+    saved_min_batch = _td.MIN_DEVICE_BATCH
+    _td.MIN_DEVICE_BATCH = 1  # keep micro-batches on the device path
+    batcher.start()
+    try:
+        _warm_route(client)
+        # warm with the exact phase workload (same corpus, same
+        # concurrency): a different batch-shape mix would leave compile
+        # buckets cold and bill them to the baseline phase
+        for _ in range(2):
+            replay(handler, [make_request(i) for i in range(n_sub)], 64)
+
+        # canary overhead: the same corpus, plane detached vs attached
+        # (the baseline replays first so cache warmth favors the
+        # canaried run, making the reported overhead conservative)
+        base = run_phase("baseline_detached")
+        driver.set_integrity(plane)
+        clean = run_phase("clean")
+        overhead = (
+            (clean["p50_ms"] - base["p50_ms"]) / base["p50_ms"]
+            if base["p50_ms"] else 0.0
+        )
+
+        # injected SDC: one device's canary rows bit-flip every batch;
+        # detection latency = arm -> corruption quarantine trip
+        plan = disp.plan()
+        sick = plan.partitions[0].device
+        t_arm = time.monotonic()
+        FAULTS.arm(device_point("integrity.canary", sick), mode="error")
+        sdc = run_phase("injected_sdc", sick_device=sick)
+        snap = plane.snapshot()
+        q = snap["quarantined"].get(str(sick))
+        detection_s = (
+            round((time.monotonic() - t_arm) - q["for_s"], 3)
+            if q else None
+        )
+        sdc["detection_latency_s"] = detection_s
+
+        # heal: disarm the flip, golden self-test replays clean
+        FAULTS.reset()
+        healed = plane.selftest(sick)
+        run_phase("selftest_healed", selftest_pass=healed)
+        plane.drain_shadow()
+    finally:
+        _td.MIN_DEVICE_BATCH = saved_min_batch
+        batcher.stop()
+        plane.close()
+        FAULTS.reset()
+        recorder.stop()
+    snap = plane.snapshot()
+    sampled = snap["shadow"]["sampled"]
+    return {
+        "constraints": n_constraints,
+        "partitions": k,
+        "phases": phases,
+        "divergence_rate": round(
+            snap["shadow"]["divergences"] / sampled, 4
+        ) if sampled else 0.0,
+        "shadow_sampled": sampled,
+        "canary_overhead_frac": round(overhead, 4),
+        "detection_latency_s": detection_s,
+        "selftest_healed": bool(healed),
+        "canary": snap["canary"],
+        "selftest": snap["selftest"],
+    }
+
+
 def _sched_request(i, cls):
     """A bench request pinned to one of two tenant namespaces: the
     25% "quiet" class (well-behaved, inside its fair share) vs the 75%
@@ -2399,6 +2532,13 @@ def _summarize(mode, res):
                       "error_budget_remaining"):
                 if k in res:
                     head[k] = res[k]
+        elif mode == "integrity":
+            head["phases"] = len(res.get("phases") or [])
+            for k in ("divergence_rate", "canary_overhead_frac",
+                      "detection_latency_s", "selftest_healed",
+                      "shadow_sampled"):
+                if k in res:
+                    head[k] = res[k]
         elif mode == "sched":
             head["phases"] = len(res.get("phases") or [])
             for k in ("quiet_p50_ms", "quiet_p99_ms", "noisy_p50_ms",
@@ -2567,6 +2707,14 @@ if __name__ == "__main__":
         res = run_slo_bench(n_req, n_con)
         print(json.dumps(res))
         print(_summarize("slo", res))
+    elif "--integrity" in sys.argv:
+        pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+        n_req = int(pos[0]) if pos else 1_800
+        n_con = int(pos[1]) if len(pos) > 1 else 20
+        k = int(pos[2]) if len(pos) > 2 else 3
+        res = run_integrity_bench(n_req, n_con, k)
+        print(json.dumps(res))
+        print(_summarize("integrity", res))
     elif "--sched" in sys.argv:
         pos = [a for a in sys.argv[1:] if not a.startswith("--")]
         dur = float(pos[0]) if pos else 6.0

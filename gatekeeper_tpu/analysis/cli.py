@@ -10,6 +10,8 @@
         [--json] [--baseline FILE] [--write-baseline FILE]
     python -m gatekeeper_tpu.analysis ir deploy/ [more paths...]
         [--json] [--baseline FILE] [--write-baseline FILE]
+    python -m gatekeeper_tpu.analysis canary deploy/ [more paths...]
+        [--json] [--baseline FILE] [--write-baseline FILE]
     python -m gatekeeper_tpu.analysis all [deploy/policies]
 
 Default mode scans the given files/directories for ConstraintTemplate
@@ -46,13 +48,21 @@ parameters, no-op checks, unreachable branches), and the fused-path
 taxonomy for anything routed to the interpreter. Baseline manifest:
 {"ir": {subject: [codes]}}.
 
+`canary` mode runs the verdict-integrity derivability gate (GK-I0xx,
+docs/robustness.md §Verdict integrity): every ConstraintTemplate must
+derive at least one synthetic canary review the host interpreter
+convicts — otherwise its golden digests all pin the empty verdict and
+device corruption suppressing its violations is undetectable.
+External-data templates run against pinned stub provider responses,
+never skipped. Baseline manifest: {"canary": {kind: [codes]}}.
+
 `all` mode is the one-shot repo gate: templates + mutators +
-providers + corpus + ir over one directory (default
+providers + corpus + ir + canary over one directory (default
 `deploy/policies`), each compared against its conventional checked-in
 baseline when present (`analysis-baseline.json`,
 `mutators-baseline.json`, `providers-baseline.json`,
-`corpus-baseline.json`, `ir-baseline.json` in that directory), folded
-into a single exit code.
+`corpus-baseline.json`, `ir-baseline.json`, `canary-baseline.json` in
+that directory), folded into a single exit code.
 
 Shared contract across all subcommands (normalized in PR 15 — they
 had grown ad hoc per PR):
@@ -465,6 +475,46 @@ def run_ir(argv: List[str]) -> int:
     return rc
 
 
+def run_canary(argv: List[str]) -> int:
+    """`canary` mode: the verdict-integrity derivability gate
+    (GK-I0xx, docs/robustness.md §Verdict integrity). Every template
+    must derive at least one synthetic canary the host interpreter
+    convicts; external-data templates get pinned stub provider
+    responses — they are never silently skipped."""
+    from .canarygate import canary_lints
+
+    ap = argparse.ArgumentParser(
+        prog="python -m gatekeeper_tpu.analysis canary",
+        description=(
+            "Verdict-integrity canary derivability gate (every "
+            "template must convict a synthetic canary review)"
+        ),
+    )
+    ap.add_argument("paths", nargs="+", help="policy YAML files or dirs")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--baseline", help="code manifest to compare against")
+    ap.add_argument(
+        "--write-baseline", help="write the current codes to FILE"
+    )
+    args = ap.parse_args(argv)
+
+    template_docs = [
+        (src, doc)
+        for src, doc in collect_templates(args.paths)
+        if isinstance(doc, dict)  # bare .rego carries no constraints
+    ]
+    if not template_docs:
+        print("no ConstraintTemplates found", file=sys.stderr)
+        return 2
+
+    lints = canary_lints(
+        template_docs,
+        collect_constraints(args.paths),
+        collect_providers(args.paths),
+    )
+    return _run_code_lints(args, "canary", "template", lints)
+
+
 def run_all(argv: List[str]) -> int:
     """`all` mode: the one-shot repo gate. Runs templates + mutators +
     providers + corpus over one directory against their conventional
@@ -491,6 +541,7 @@ def run_all(argv: List[str]) -> int:
         ("providers", run_providers, "providers-baseline.json"),
         ("corpus", run_corpus, "corpus-baseline.json"),
         ("ir", run_ir, "ir-baseline.json"),
+        ("canary", run_canary, "canary-baseline.json"),
     ]
     results: Dict[str, int] = {}
     for name, fn, baseline_name in planes:
@@ -526,6 +577,8 @@ def run(argv: List[str]) -> int:
         return run_corpus(argv[1:])
     if argv and argv[0] == "ir":
         return run_ir(argv[1:])
+    if argv and argv[0] == "canary":
+        return run_canary(argv[1:])
     if argv and argv[0] == "all":
         return run_all(argv[1:])
     ap = argparse.ArgumentParser(

@@ -52,7 +52,7 @@ from ..analysis import (
     analyze_modules,
 )
 from ..engine.matchkernel import matchspec_to_np
-from ..faults import device_point, fire
+from ..faults import FaultError, device_point, fire
 from ..engine.patterns import PatternRegistry, _match
 from ..engine.programs import Program, ProgramEvaluator, compile_program
 from ..engine.symbolic import CompilerEnv, CompileUnsupported
@@ -327,6 +327,11 @@ class TpuDriver(RegoDriver):
         # with the partition that paid it (docs/observability.md
         # §Cost attribution)
         self.attributor = None
+        # integrity.IntegrityPlane (set_integrity): canary rows packed
+        # into each dispatch's padding slots + the golden swap gate
+        # (docs/robustness.md §Verdict integrity). None = no canary
+        # packing, no golden gate — the plain-driver test default.
+        self.integrity = None
         # incremental compile plane (docs/compile.md): template IR
         # hashes + per-subset content signatures drive minimal
         # recompiles — churn restages only partitions whose signature
@@ -470,6 +475,92 @@ class TpuDriver(RegoDriver):
         """Wire an obs.CostAttributor: from here on every dispatch's
         device-execute time is apportioned per constraint."""
         self.attributor = attributor
+
+    def set_integrity(self, plane) -> None:
+        """Wire an integrity.IntegrityPlane: from here on every fused
+        dispatch packs canary rows into its padding slots and reports
+        their verdict digests, and prepare_subset gates warm-swaps on
+        a golden-batch replay (docs/robustness.md §Verdict integrity).
+        """
+        self.integrity = plane
+        if plane is not None:
+            plane.bind_driver(self)
+
+    def _interp_closure(self, target: str, constraints):
+        """Host-interpreter ground-truth closure for canary golden
+        derivation: evaluates ONE review against exactly the constraint
+        set the fused dispatch serves. Called under the serving mutex
+        (reentrant) the first time a signature needs its golden set."""
+        def interp(review):
+            with self._mutex:
+                return RegoDriver._violation(
+                    self, target, {"review": review}, None,
+                    constraints=list(constraints),
+                )
+        return interp
+
+    def _canary_pack(
+        self, target: str, sigkey: str, constraints, reviews,
+        handler, ns_cache, rej_constraints,
+    ):
+        """Append up to K canary reviews into the padding slots this
+        dispatch's shape bucket already wastes (the _stage_corpus
+        padding formula — packing canaries never grows the staged
+        shape, so the device cost is zero). Returns (reviews,
+        canary_autorejects): the caller strips the canary tail from
+        the eval split and reports it via _canary_check AFTER releasing
+        the serving mutex. Canary autorejects mirror the live path so
+        the digest compares against the interpreter golden, which
+        includes autoreject results."""
+        integ = self.integrity
+        if integ is None:
+            return reviews, []
+        n = len(reviews)
+        chunk = min(N_CHUNK, _bucket(n, lo=64))
+        padded = chunk * -(-n // chunk)
+        slots = padded - n
+        if slots <= 0:
+            return reviews, []
+        try:
+            canaries = integ.canaries_for(
+                target, sigkey, constraints,
+                self._interp_closure(target, constraints), slots,
+            )
+        except Exception:
+            return reviews, []
+        if not canaries:
+            return reviews, []
+        canary_autorej: List[List[Result]] = []
+        for r in canaries:
+            out: List[Result] = []
+            if rej_constraints and handler.review_autorejects(r, ns_cache):
+                out = [
+                    _autoreject_result(c, r) for c in rej_constraints
+                ]
+            canary_autorej.append(out)
+        return list(reviews) + list(canaries), canary_autorej
+
+    def _canary_check(
+        self, target: str, sigkey: str, device, split, canary_autorej,
+        subset=None,
+    ):
+        """Digest-compare the stripped canary verdicts against the
+        golden set (off the serving mutex — the plane may trip
+        dispatcher quarantine)."""
+        integ = self.integrity
+        if integ is None or not canary_autorej:
+            return
+        try:
+            integ.check_canaries(
+                target, sigkey, device,
+                [
+                    auto + ev
+                    for auto, ev in zip(canary_autorej, split)
+                ],
+                subset=subset,
+            )
+        except Exception:
+            pass  # integrity accounting must never fail a dispatch
 
     @staticmethod
     def _static_cost(program) -> float:
@@ -1804,10 +1895,26 @@ class TpuDriver(RegoDriver):
                         for c in rej_constraints
                     ]
                 autorejects.append(out)
+            # verdict-integrity canaries ride in the padding slots this
+            # bucket already wastes; their results are stripped below,
+            # BEFORE any merge — a canary verdict is never a policy
+            # outcome (docs/robustness.md §Verdict integrity)
+            n_live = len(reviews)
+            sigkey = cs.signature or f"gen{self._constraint_gen}"
+            reviews, canary_autorej = self._canary_pack(
+                target, sigkey, cs.constraints, reviews,
+                handler, ns_cache, rej_constraints,
+            )
             split = self._eval_reviews_split(
                 target, reviews, None, None, cset=cs,
                 partition=(partition if partition is not None else device),
             )
+            canary_split = split[n_live:]
+            split = split[:n_live]
+        self._canary_check(
+            target, sigkey, device, canary_split, canary_autorej,
+            subset=frozenset(subset),
+        )
         return [
             Response(target=target, results=auto + ev)
             for auto, ev in zip(autorejects, split)
@@ -1866,6 +1973,18 @@ class TpuDriver(RegoDriver):
             shadow.policy = self.kernel.stage_policy(
                 shadow.programs, shadow.ms
             )
+        # golden swap gate (docs/robustness.md §Verdict integrity): the
+        # staged shadow sub-program must reproduce the golden canary
+        # digests before it may swap live — a corrupting compile or a
+        # bad staged tensor is rejected here, with the OLD sub-program
+        # still serving
+        if self.integrity is not None and shadow.constraints:
+            if not self._golden_gate(target, shadow):
+                self._count(
+                    "program_swap_rejected_total",
+                    reason="golden_mismatch", target=target,
+                )
+                return False
         # mid-swap fault point: failure here must leave the old
         # sub-program live (tests/test_compile_plane.py)
         fire("compile.swap")
@@ -1884,6 +2003,60 @@ class TpuDriver(RegoDriver):
             except Exception:
                 pass
         return True
+
+    def _golden_gate(self, target: str, shadow) -> bool:
+        """Warm-swap integrity gate: replay the golden canary batch
+        through the STAGED (not yet live) sub-program and digest-compare
+        against the interpreter-pinned golden set. The
+        `integrity.selftest` fault point injects a corrupting shadow
+        slot. A shape bucket with no compiled kernel yet skips the
+        fused replay (ColdKernel) — the canary tier catches corruption
+        on the first live dispatch instead."""
+        from ..integrity.canary import result_digest
+        from ..parallel.sharding import ColdKernel
+
+        integ = self.integrity
+        sigkey = shadow.signature or f"gen{self._constraint_gen}"
+        try:
+            entry = integ.golden_for(
+                target, sigkey, shadow.constraints,
+                self._interp_closure(target, shadow.constraints),
+            )
+        except Exception:
+            entry = None
+        if entry is None or not entry.get("reviews"):
+            return True
+        try:
+            fire("integrity.selftest")
+        except FaultError:
+            return False
+        with self._mutex:
+            handler = self._handler(target)
+            ns_cache = self._ns_cache(target)
+        rej_constraints = [
+            c for c in shadow.constraints
+            if handler.constraint_needs_context(c)
+        ]
+        try:
+            split = self._eval_reviews_split(
+                target, list(entry["reviews"]), None, None,
+                require_compiled=True, cset=shadow,
+            )
+        except ColdKernel:
+            return True
+        except Exception:
+            return False
+        got = []
+        for review, ev in zip(entry["reviews"], split):
+            auto: List[Result] = []
+            if rej_constraints and handler.review_autorejects(
+                review, ns_cache
+            ):
+                auto = [
+                    _autoreject_result(c, review) for c in rej_constraints
+                ]
+            got.append(result_digest(auto + ev))
+        return got == entry["digests"][: len(got)]
 
     # -- serve-while-compiling (cold-start) ----------------------------------
 
@@ -2054,10 +2227,22 @@ class TpuDriver(RegoDriver):
                 autorejects.append(out)
             from ..parallel.sharding import ColdKernel
 
+            # monolithic dispatch canaries: same padding-slot packing
+            # as the partitioned path, golden keyed per constraint
+            # generation (the monolith has no subset signature), all
+            # attributed to logical device 0
+            n_live = len(reviews)
+            sigkey = f"gen{self._constraint_gen}"
+            reviews, canary_autorej = self._canary_pack(
+                target, sigkey, constraints, reviews,
+                handler, ns_cache, rej_constraints,
+            )
             try:
                 split = self._eval_reviews_split(
                     target, reviews, None, None, require_compiled=True
                 )
+                canary_split = split[n_live:]
+                split = split[:n_live]
             except ColdKernel:
                 # novel shape bucket before its kernel compiled: serve
                 # this batch on the interpreter and compile it in the
@@ -2074,6 +2259,7 @@ class TpuDriver(RegoDriver):
                 return [
                     Response(target=target, results=r) for r in split
                 ]
+        self._canary_check(target, sigkey, 0, canary_split, canary_autorej)
         return [
             Response(target=target, results=auto + ev)
             for auto, ev in zip(autorejects, split)

@@ -133,6 +133,14 @@ class Runner:
         # "fifo" is the bit-compatible legacy queue and the rollback
         # path (--sched-policy fifo)
         sched_policy: str = "fifo",
+        # verdict-integrity plane (docs/robustness.md §Verdict
+        # integrity): canary rows in every fused dispatch's padding
+        # slots, a CRC-sampled shadow oracle, and corruption
+        # quarantine. True (default) builds an IntegrityPlane; False
+        # disables the plane entirely (the rollback path); an
+        # IntegrityPlane instance is adopted as-is (tests/bench tune
+        # sampling/thresholds)
+        integrity=True,
     ):
         from ..logs import null_logger
         from ..obs import (
@@ -196,6 +204,31 @@ class Runner:
             replica=pod_name,
         )
         self.decisions.slo = self.slo
+        # verdict-integrity plane (docs/robustness.md §Verdict
+        # integrity): golden canary sets ride the ProgramStore as
+        # sidecars when the driver has one; the driver packs/strips
+        # canaries and gates warm-swaps from here on
+        self.integrity = None
+        if integrity:
+            from ..integrity import IntegrityPlane
+
+            self.integrity = (
+                integrity
+                if isinstance(integrity, IntegrityPlane)
+                else IntegrityPlane(
+                    metrics=metrics,
+                    decisions=self.decisions,
+                    recorder=self.recorder,
+                    store=getattr(driver, "program_store", None),
+                )
+            )
+            self.integrity.metrics = metrics
+            self.integrity.decisions = self.decisions
+            self.integrity.recorder = self.recorder
+            set_i = getattr(driver, "set_integrity", None)
+            if set_i is not None:
+                set_i(self.integrity)
+            self.integrity.attach_client(client)
         self.excluder = Excluder()
         self.tracker = ReadinessTracker()
         self.switch = ControllerSwitch()
@@ -544,6 +577,7 @@ class Runner:
                 corpus=self.corpus,
                 sched_policy=self.sched_policy,
                 slo=self.slo,
+                integrity=self.integrity,
             )
             # postmortem state sources: what a flight record snapshots
             # alongside the trace tail / cost table / fault points
@@ -571,6 +605,12 @@ class Runner:
                 )
             if self.fleet is not None:
                 self.recorder.add_source("fleet", self.fleet.snapshot)
+            if self.integrity is not None:
+                # a verdict_divergence / device_quarantine record
+                # embeds the integrity plane's ledger + golden state
+                self.recorder.add_source(
+                    "integrity", self.integrity.snapshot
+                )
             self.webhook.start()
             if (
                 self.fleet is not None
@@ -808,6 +848,8 @@ class Runner:
         self._event_stop.set()
         self._warm_stop.set()
         self._event_wake.set()
+        if self.integrity is not None:
+            self.integrity.close()  # stop the shadow-oracle worker
         if self.ca_injector is not None:
             self.ca_injector.stop()
         if self.fleet is not None:
@@ -982,6 +1024,14 @@ class Runner:
                     # breakdown at /debug/slo); docs/observability.md
                     # §SLO & saturation
                     stats["slo"] = runner.slo.autoscaler()
+                    # verdict-integrity headline: canary/shadow/
+                    # self-test counters + corruption-quarantine state
+                    # (full payload at /debug/integrity;
+                    # docs/robustness.md §Verdict integrity)
+                    if runner.integrity is not None:
+                        stats["integrity"] = (
+                            runner.integrity.snapshot()
+                        )
                     # admission-scheduler headline: per-plane policy,
                     # overload state, shed split, and per-tenant
                     # quota/usage table (full payload at /debug/sched;
@@ -1142,6 +1192,21 @@ class Runner:
                         runner.slo, self.path
                     ).encode()
                     self.send_response(200)
+                elif self.path == "/debug/integrity":
+                    # verdict-integrity plane: golden canary sets,
+                    # per-device mismatch ledger, shadow-oracle
+                    # counters, corruption-quarantine state
+                    # (docs/robustness.md §Verdict integrity)
+                    if runner.integrity is not None:
+                        payload = json.dumps(
+                            runner.integrity.snapshot()
+                        ).encode()
+                        self.send_response(200)
+                    else:
+                        payload = (
+                            b'{"error": "integrity disabled"}'
+                        )
+                        self.send_response(404)
                 elif self.path == "/healthz":
                     payload = b'{"ok": true}'
                     self.send_response(200)

@@ -64,7 +64,10 @@ DECISION_SCHEMA_FIELDS = (
 # verdicts that are never sampled out (the "error" half of head+error
 # sampling); routes that force retention are judged separately
 _ALWAYS_KEEP_VERDICTS = frozenset(
-    ("deny", "dryrun", "error", "shed", "unavailable")
+    # verdict_divergence: the shadow oracle's SDC evidence record —
+    # far too rare and too important to lose to allow-sampling
+    ("deny", "dryrun", "error", "shed", "unavailable",
+     "verdict_divergence")
 )
 _ALWAYS_KEEP_ROUTES = frozenset(
     ("host", "degraded", "fallback", "unavailable")

@@ -348,6 +348,9 @@ class PartitionDispatcher:
         self._plan_costs: Dict[str, Dict[str, float]] = {}
         self._breakers: Dict[int, CircuitBreaker] = {}
         self._manual_quarantine: set = set()
+        # why each manually-quarantined device is out ("manual" |
+        # "corruption"); corruption entries only clear through heal()
+        self._quarantine_reasons: Dict[int, str] = {}
         self._plan: Optional[PartitionPlan] = None
         self._plan_key: Any = None
         self._plan_gen = 0
@@ -427,27 +430,41 @@ class PartitionDispatcher:
         # after its probe (run_probes) actually closes the breaker
         return b is None or b.state == CLOSED
 
-    def quarantine(self, device: int) -> None:
-        """Operator/scenario quarantine: take the device out of the
-        pool immediately (its partitions re-home on the next plan
-        build) without touching its breaker."""
+    def quarantine(self, device: int, reason: str = "manual") -> None:
+        """Operator/scenario/integrity quarantine: take the device out
+        of the pool immediately (its partitions re-home on the next
+        plan build) without touching its breaker. `reason` separates
+        the semantics (docs/robustness.md §Verdict integrity):
+        "manual" is an operator decision, "corruption" is the
+        verdict-integrity plane's SDC verdict — both use the same
+        mechanics, but a corruption quarantine heals ONLY through a
+        clean golden self-test (IntegrityPlane.selftest), never a
+        probe/timer."""
         with self._lock:
             self._manual_quarantine.add(int(device))
+            self._quarantine_reasons[int(device)] = str(reason)
         self._export_quarantine()
+        if self.metrics is not None:
+            self.metrics.record(
+                "device_quarantine_total", 1,
+                plane=self.plane, reason=str(reason),
+            )
         if self.recorder is not None:
             try:
                 self.recorder.trigger(
                     "device_quarantine", plane=self.plane,
                     device=int(device), manual=True,
+                    reason=str(reason),
                 )
             except Exception:
                 pass
 
     def heal(self, device: int) -> None:
-        """Lift an operator quarantine (a breaker-driven quarantine
-        heals through its own probe cycle instead)."""
+        """Lift an operator/integrity quarantine (a breaker-driven
+        quarantine heals through its own probe cycle instead)."""
         with self._lock:
             self._manual_quarantine.discard(int(device))
+            self._quarantine_reasons.pop(int(device), None)
         self._export_quarantine()
 
     def _export_quarantine(self) -> None:
@@ -1030,6 +1047,10 @@ class PartitionDispatcher:
                     d for d in self.devices if not self._device_healthy(d)
                 ),
                 "manual_quarantine": sorted(self._manual_quarantine),
+                "quarantine_reasons": {
+                    str(d): r
+                    for d, r in self._quarantine_reasons.items()
+                },
                 "breakers": {
                     b.name: b.snapshot()
                     for b in self._breakers.values()

@@ -39,6 +39,10 @@ with the selected operations; flags mirror the reference's surface:
   --drain-grace          seconds /readyz reports not-ready before the
                          webhook listener closes on SIGTERM (graceful
                          drain, docs/robustness.md)
+  --no-integrity         disable the verdict-integrity plane (canary
+                         rows, sampled shadow oracle, SDC quarantine —
+                         docs/robustness.md §Verdict integrity); on by
+                         default, this is the rollback path
   --kube-url/--kube-token/--kube-ca  out-of-cluster apiserver access
 """
 
@@ -102,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
     # the LB routes away, THEN the listener closes and in-flight
     # requests complete — docs/robustness.md)
     p.add_argument("--drain-grace", type=float, default=1.0)
+    # verdict-integrity plane (docs/robustness.md §Verdict integrity):
+    # on by default; the flag exists so an operator can bisect a
+    # regression back to the plane without a rebuild
+    p.add_argument("--no-integrity", dest="integrity",
+                   action="store_false", default=True)
     # agent-action admission (docs/targets.md): registers the
     # AgentActionTarget so agent templates ingest and the webhook
     # serves POST /v1/agent/review
@@ -172,6 +181,7 @@ def build_runner(args, log=None, webhook_tls: bool = True):
         ),  # 0 -> unbounded
         partitions=getattr(args, "partitions", 0),
         sched_policy=getattr(args, "sched_policy", "fifo"),
+        integrity=getattr(args, "integrity", True),
         drain_grace_s=getattr(args, "drain_grace", 0.0),
         bind_addr="0.0.0.0",  # kubelet probes and the apiserver dial
         # the pod IP, not loopback

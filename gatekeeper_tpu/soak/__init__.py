@@ -41,6 +41,7 @@ from .scenario import (  # noqa: F401
     load_scenario,
     multi_tenant_overload_scenario,
     multi_tenant_smoke_scenario,
+    sdc_smoke_scenario,
     smoke_scenario,
 )
 from .harness import SoakHarness, run_soak  # noqa: F401

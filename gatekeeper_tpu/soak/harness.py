@@ -226,6 +226,7 @@ class _Replica:
         self.decisions = None  # per-admission decision log
         self.slo = None  # live streaming SLO engine (obs/slo.py)
         self.corpus = None  # corpus static-analysis plane
+        self.integrity = None  # verdict-integrity plane (canary/SDC)
 
     @property
     def base_url(self) -> str:
@@ -344,6 +345,21 @@ class SoakHarness:
         rep.client = Backend(rep.driver).new_client(
             K8sValidationTarget(), AgentActionTarget()
         )
+        # verdict-integrity plane (docs/robustness.md §Verdict
+        # integrity): canary rows ride every padded dispatch and a
+        # CRC-sampled shadow oracle re-checks live verdicts — the sdc
+        # scenario's bit-flip detection + corruption-quarantine story
+        from ..integrity import IntegrityPlane
+
+        rep.integrity = IntegrityPlane(
+            metrics=rep.metrics,
+            decisions=rep.decisions,
+            recorder=rep.recorder,
+            quarantine_threshold=2,
+        )
+        rep.driver.set_integrity(rep.integrity)
+        rep.integrity.attach_client(rep.client)
+        rep.recorder.add_source("integrity", rep.integrity.snapshot)
         rep.external = ExternalDataSystem(metrics=rep.metrics)
         if self.cluster is not None:
             from ..fleet import FleetPlane
@@ -452,6 +468,7 @@ class SoakHarness:
             sched_policy=scn.sched_policy,
             slo=rep.slo,
             attributor=rep.attributor,
+            integrity=rep.integrity,
         )
         rep.recorder.add_source(
             "webhook", lambda rep=rep: {
@@ -534,6 +551,10 @@ class SoakHarness:
             rep.server.partitioner = disp  # server.stop() closes it
             rep.server.batcher.partitioner = disp
             rep.server.batcher.breaker = None
+            # corruption quarantine needs the dispatcher to re-home a
+            # bit-flipping device's partitions (built after the server,
+            # so the server's own attach above never saw it)
+            rep.integrity.attach_dispatcher(disp)
             if rep.fleet_plane is not None:
                 # per-device breakers gossip under their
                 # device:validation:<id> keys as they are created
@@ -783,6 +804,17 @@ class SoakHarness:
             for rep in self.replicas:
                 if rep.partitioner is not None:
                     rep.partitioner.heal(dev)
+        elif action == "selftest_device":
+            # golden self-test: the only heal path for a corruption
+            # quarantine (docs/robustness.md §Verdict integrity)
+            dev = int(params.get("device", 1))
+            for rep in self.replicas:
+                if rep.integrity is not None:
+                    ok = rep.integrity.selftest(dev)
+                    self._log(
+                        f"selftest device={dev} on {rep.name}: "
+                        f"{'pass' if ok else 'fail'}"
+                    )
         elif action == "kill_replica":
             idx = int(params.get("replica", 0))
             rep = self.replicas[idx]
@@ -865,6 +897,10 @@ class SoakHarness:
         # typed reason + per-tenant-class attainment read straight
         # from the decision log's full-stream tenant counters
         sched_pred = sched_capped = sched_qfull = sched_throttled = 0
+        # verdict-integrity plane: canary mismatch batches + shadow
+        # divergences (cumulative), corruption-quarantined devices
+        # (instantaneous) — the sdc check's evidence columns
+        canary_mism = shadow_div = quarantined_now = 0
         tn = self.scenario.tenants or {}
         quiet_ns = str(tn.get("quiet_ns", "ns-quiet"))
         noisy_ns = str(tn.get("noisy_ns", "ns-noisy"))
@@ -986,6 +1022,14 @@ class SoakHarness:
                     corpus_recomputes += int(rep.corpus.recomputes)
                 except Exception:
                     pass
+            if rep.integrity is not None:
+                try:
+                    isnap = rep.integrity.snapshot()
+                    canary_mism += isnap["canary"]["mismatch_batches"]
+                    shadow_div += isnap["shadow"]["divergences"]
+                    quarantined_now += len(isnap["quarantined"])
+                except Exception:
+                    pass
             if rep.partitioner is not None:
                 # pruning width (mask-gated partition skipping): p50/
                 # max partitions touched per batch over the recent
@@ -1030,6 +1074,9 @@ class SoakHarness:
             "program_carryforwards_cum": program_carryforwards,
             "program_compiles_cum": program_compiles,
             "corpus_recomputes_cum": corpus_recomputes,
+            "canary_mismatch_cum": canary_mism,
+            "shadow_divergence_cum": shadow_div,
+            "quarantined_devices": quarantined_now,
             # live SLO plane (obs/slo.py)
             "slo_saturation": slo_sat,
             "slo_burning": slo_burning,
@@ -1162,6 +1209,20 @@ class SoakHarness:
                     cur["corpus_recomputes_cum"]
                     - prev["corpus_recomputes_cum"]
                 ),
+                # verdict-integrity plane (docs/robustness.md §Verdict
+                # integrity): canary mismatch batches + shadow-oracle
+                # divergences this window, and how many devices sit in
+                # corruption quarantine at the window's close — the
+                # sdc_detected_and_quarantined check's evidence
+                "canary_mismatches": (
+                    cur["canary_mismatch_cum"]
+                    - prev["canary_mismatch_cum"]
+                ),
+                "shadow_divergences": (
+                    cur["shadow_divergence_cum"]
+                    - prev["shadow_divergence_cum"]
+                ),
+                "quarantined_devices": cur["quarantined_devices"],
                 # live SLO plane at this window's close: worst-replica
                 # saturation, live fast-window attainment/burn, any
                 # plane in the burning state, breaches fired this
@@ -1425,6 +1486,8 @@ class SoakHarness:
                 rep.fleet_plane.stop()
             if rep.rotator is not None:
                 rep.rotator.stop()
+            if rep.integrity is not None:
+                rep.integrity.close()
             if rep.recorder is not None:
                 rep.recorder.stop()
         self.stub.stop()

@@ -75,6 +75,11 @@ ACTIONS: Dict[str, tuple] = {
     # healthy devices) and the matching heal
     "quarantine_device": (),     # device (default 1)
     "heal_device": (),           # device (default 1)
+    # verdict-integrity plane (docs/robustness.md §Verdict integrity):
+    # run the golden self-test against one device — the ONLY path that
+    # heals a corruption quarantine (the sdc scenario fires it after
+    # disarming the bit-flip)
+    "selftest_device": (),       # device (default 1)
 }
 
 
@@ -224,7 +229,9 @@ class Scenario:
                         f"kill_replica index {idx} out of range for "
                         f"{self.replicas} replicas"
                     )
-            if ev.action in ("quarantine_device", "heal_device"):
+            if ev.action in (
+                "quarantine_device", "heal_device", "selftest_device"
+            ):
                 if self.partitions < 1:
                     raise ValueError(
                         f"{ev.action} requires partitions >= 1"
@@ -327,6 +334,45 @@ def smoke_scenario() -> Scenario:
             # serve every request through it (ingest_zero_degraded)
             {"at": 9.0, "action": "phase", "name": "ingest"},
             {"at": 9.2, "action": "ingest_wave", "count": 6},
+        ],
+    })
+
+
+def sdc_smoke_scenario() -> Scenario:
+    """The ~9 s verdict-integrity smoke (docs/robustness.md §Verdict
+    integrity): partitioned serving with a device bit-flip armed
+    mid-steady-state via the `integrity.canary[device=1]` fault point.
+    The canary tier must detect the corruption, trip the device into
+    quarantine with reason `corruption` (its partitions re-home while
+    healthy devices keep serving fused), and after the flip is
+    disarmed the golden self-test — the ONLY corruption heal path —
+    returns the device to the pool. The report judges it all through
+    `sdc_detected_and_quarantined` over the canary_mismatches /
+    quarantined_devices window columns."""
+    return Scenario.from_dict({
+        "name": "soak-sdc-smoke",
+        "duration_s": 9.0,
+        "rps": 30.0,
+        "deadline_s": 0.5,
+        "window_s": 1.0,
+        "seed": 77,
+        "replicas": 1,
+        "tls": False,
+        "constraints": 8,
+        "external_keys": 5,
+        "partitions": 2,
+        # keep micro-batches on the device path so canary rows
+        # actually ride the dispatches the bit-flip corrupts
+        "min_device_batch": 1,
+        "breaker": {"failure_threshold": 3, "recovery_seconds": 1.0},
+        "events": [
+            {"at": 0.0, "action": "phase", "name": "steady"},
+            {"at": 3.0, "action": "phase", "name": "sdc"},
+            {"at": 3.1, "action": "arm_fault",
+             "point": "integrity.canary[device=1]", "mode": "error"},
+            {"at": 6.0, "action": "disarm_faults"},
+            {"at": 6.2, "action": "selftest_device", "device": 1},
+            {"at": 6.5, "action": "phase", "name": "recovery"},
         ],
     })
 

@@ -84,6 +84,14 @@ REQUIRED_FIELDS: Dict[str, tuple] = {
         "quiet_attainment", "noisy_attainment", "tenant_attainment_min",
         "predicted_miss_shed", "blind_shed",
     ),
+    # the verdict-integrity lane (docs/robustness.md §Verdict
+    # integrity): clean → injected-SDC → self-test-healed. Divergence
+    # rate and canary overhead are bench_compare WATCHED (both
+    # up-bad); detection latency is arm -> corruption quarantine
+    "integrity": (
+        "phases", "divergence_rate", "canary_overhead_frac",
+        "detection_latency_s", "selftest_healed",
+    ),
 }
 
 

@@ -136,12 +136,18 @@ class MicroBatcher:
         sched_policy: str = "fifo",
         slo=None,
         attributor=None,
+        # integrity.IntegrityPlane: post-response shadow-oracle
+        # sampling — a deterministic CRC(trace_id) fraction of live
+        # admissions re-evaluates asynchronously on the host
+        # interpreter (docs/robustness.md §Verdict integrity)
+        integrity=None,
     ):
         self.client = client
         self.target = target
         self.partitioner = partitioner
         self.recorder = recorder
         self.decisions = decisions
+        self.integrity = integrity
         # (constraint generation, corpus size) cache for rows facts
         self._rows_cache: Optional[Tuple[Any, int]] = None
         if partitioner is not None and breaker is None:
@@ -573,9 +579,27 @@ class MicroBatcher:
                 self._liveness_skipped_count() - skip0
             ),
         )
-        for (_, fut, _, _, _, _), responses in zip(batch, all_responses):
+        for (_, fut, ctx, _, _, _), review, responses in zip(
+            batch, reviews, all_responses
+        ):
             resp = responses.by_target.get(self.target)
-            fut.set_result(resp.results if resp is not None else [])
+            results = resp.results if resp is not None else []
+            fut.set_result(results)
+            self._note_integrity(ctx, review, results, route="batched")
+
+    def _note_integrity(self, ctx, review, results, **facts) -> None:
+        """Offer one served admission to the verdict-integrity plane's
+        shadow oracle (CRC-sampled, asynchronous, post-response —
+        docs/robustness.md §Verdict integrity). Never fails a request."""
+        if self.integrity is None:
+            return
+        try:
+            self.integrity.note_live(
+                getattr(ctx, "trace_id", None), review, results,
+                plane=self.plane, **facts,
+            )
+        except Exception:
+            pass
 
     @staticmethod
     def _ensure_staged_nowait(part, p) -> bool:
@@ -827,15 +851,17 @@ class MicroBatcher:
                     self._liveness_skipped_count() - skip0
                 ),
             )
-        for i, (_, fut, _, _, _, _) in enumerate(batch):
+        for i, (_, fut, ctx, _, _, _) in enumerate(batch):
             if i in errors:
                 fut.set_exception(errors[i])
             else:
-                fut.set_result(
-                    merge_partition_results(
-                        [rows[i] for rows in part_results.values()],
-                        plan.order,
-                    )
+                merged = merge_partition_results(
+                    [rows[i] for rows in part_results.values()],
+                    plan.order,
+                )
+                fut.set_result(merged)
+                self._note_integrity(
+                    ctx, reviews[i], merged, route="partitioned",
                 )
         part.run_probes(reviews)
 
@@ -1075,6 +1101,10 @@ class WebhookServer:
         # the obs.SloEngine feeding the overload/saturation loop.
         sched_policy: str = "fifo",
         slo=None,
+        # integrity.IntegrityPlane (docs/robustness.md §Verdict
+        # integrity): shadow-oracle sampling on the validation batcher
+        # + corruption-quarantine wiring to the partitioner
+        integrity=None,
     ):
         self.client = client  # warmup() compiles through it
         self.tracer = tracer
@@ -1119,7 +1149,19 @@ class WebhookServer:
             sched_policy=sched_policy,
             slo=slo,
             attributor=attributor,
+            integrity=integrity,
         )
+        self.integrity = integrity
+        if integrity is not None:
+            # the mismatch ledger needs the dispatcher to trip
+            # corruption quarantine; the shadow oracle re-evaluates
+            # through the serving client's host rung
+            try:
+                integrity.attach_client(client)
+                if self.partitioner is not None:
+                    integrity.attach_dispatcher(self.partitioner)
+            except Exception:
+                pass
         self.mutate_batcher = None
         self.mutation_handler = None
         if mutation_system is not None:

@@ -100,6 +100,19 @@ MODE_RESULTS = {
         "breaches": 1, "burning": False,
         "error_budget_remaining": 0.0,
     },
+    "integrity": {
+        "phases": [
+            {"phase": "clean", "p50_ms": 1.5, "canary_batches": 40},
+            {"phase": "injected_sdc", "p50_ms": 1.6,
+             "detection_latency_s": 0.4,
+             "quarantined": ["1"]},
+            {"phase": "selftest_healed", "p50_ms": 1.5,
+             "selftest_pass": True, "quarantined": []},
+        ],
+        "divergence_rate": 0.0, "canary_overhead_frac": 0.01,
+        "detection_latency_s": 0.4, "selftest_healed": True,
+        "shadow_sampled": 220,
+    },
     "sched": {
         "phases": [
             {"phase": "fifo",
@@ -138,7 +151,7 @@ def test_contract_covers_every_bench_mode_flag():
         src = f.read()
     for mode in ("ladder", "attribution", "partitions", "fleet",
                  "chaos", "churn", "external", "mutate", "soak",
-                 "slo", "sched"):
+                 "slo", "sched", "integrity"):
         assert f'"--{mode}"' in src, f"bench flag --{mode} vanished?"
         assert mode in REQUIRED_FIELDS, f"mode {mode!r} unregistered"
     assert "webhook" in REQUIRED_FIELDS  # the default (flagless) lane
