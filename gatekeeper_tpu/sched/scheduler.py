@@ -27,6 +27,13 @@ Policy `"fifo"` short-circuits everything: arrival-order batches and
 `queue_full` shedding of the newest arrival at `max_queue`, bit-compatible
 with the pre-scheduler queue (the `--sched-policy fifo` rollback path).
 
+Deadlines arrive from two front doors and are indistinguishable here:
+the HTTP path derives one from the server's request timeout, while the
+framed ingest path (docs/ingest.md §Wire format) stamps the budget in
+the frame header — `FLAG_DEADLINE` + ms — so EDF ordering and
+predictive shedding see the caller's real deadline before the payload
+JSON has even been decoded.
+
 Shed reasons (typed on `ShedError`, landing in decision records):
 
   * `queue_full`      — bounded queue at capacity, no viable victim;

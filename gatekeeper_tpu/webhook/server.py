@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from contextlib import contextmanager
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -979,6 +980,24 @@ class BatchedValidationHandler(ValidationHandler):
         )
         self.batcher = batcher
         self.request_timeout = request_timeout
+        # per-thread deadline override: the framed ingest path stamps
+        # the FRAME HEADER's budget here so the scheduler sees the
+        # caller's real deadline instead of the server-side default
+        self._deadline_local = threading.local()
+
+    @contextmanager
+    def deadline_scope(self, deadline: Optional[float]):
+        """Pin _review calls on THIS thread to an absolute monotonic
+        deadline (ingest frames carry one in the header). None is a
+        no-op scope — the default request_timeout budget applies."""
+        if deadline is None:
+            yield
+            return
+        self._deadline_local.value = deadline
+        try:
+            yield
+        finally:
+            self._deadline_local.value = None
 
     def _review(
         self, request: Dict[str, Any], tracing: bool = False, span=None
@@ -992,7 +1011,13 @@ class BatchedValidationHandler(ValidationHandler):
         # the batch worker so expiry is checked BEFORE dispatch. Tenant
         # identity is extracted BEFORE enqueue too — shed verdicts must
         # carry it, and the scheduler's quotas key on it.
-        deadline = self.batcher._now() + self.request_timeout
+        override = getattr(self._deadline_local, "value", None)
+        if override is not None:
+            deadline = override
+            budget = max(0.0, deadline - self.batcher._now())
+        else:
+            deadline = self.batcher._now() + self.request_timeout
+            budget = self.request_timeout
         tenant = {
             "namespace": request.get("namespace", ""),
             "username": (request.get("userInfo") or {}).get(
@@ -1003,13 +1028,13 @@ class BatchedValidationHandler(ValidationHandler):
             request, span_ctx=ctx, deadline=deadline, tenant=tenant
         )
         try:
-            return fut.result(timeout=self.request_timeout)
+            return fut.result(timeout=budget)
         except _FutureTimeout:
             # a hung dispatch (device stall): the caller gets the typed
             # unavailability — answered per fail policy — while the
             # worker finishes or dies in the background
             raise EvaluationTimeout(
-                f"admission evaluation exceeded {self.request_timeout}s"
+                f"admission evaluation exceeded {budget:.3f}s"
             ) from None
 
 
@@ -1105,6 +1130,17 @@ class WebhookServer:
         # integrity): shadow-oracle sampling on the validation batcher
         # + corruption-quarantine wiring to the partitioner
         integrity=None,
+        # wire-speed ingest plane (docs/ingest.md): True mounts a
+        # framed-stream listener (ingest.IngestServer) next to the
+        # legacy HTTP port — persistent multiplexed connections,
+        # zero-copy AdmissionReview decode, frame-header deadlines.
+        # Rollback is ingest=False (--ingest off): the HTTP path is
+        # untouched either way.
+        ingest: bool = False,
+        ingest_port: int = 0,
+        ingest_decode: str = "zerocopy",
+        ingest_max_inflight: int = 256,
+        ingest_workers: int = 64,
     ):
         self.client = client  # warmup() compiles through it
         self.tracer = tracer
@@ -1226,12 +1262,38 @@ class WebhookServer:
                 slo=slo,
                 attributor=attributor,
             )
+        self.ingest = None
+        if ingest:
+            # local import: ingest.server imports review_envelope back
+            # from this module
+            from ..ingest.server import IngestServer
+
+            self.ingest = IngestServer(
+                self,
+                host=bind_addr,
+                port=ingest_port,
+                decode=ingest_decode,
+                max_inflight=ingest_max_inflight,
+                workers=ingest_workers,
+                metrics=metrics,
+                tracer=tracer,
+                decision_log=decision_log,
+            )
+            self.ingest_port = self.ingest.port
         outer = self
 
         class _Handled(Exception):
             """Control flow: response already written by the branch."""
 
         class _Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: every response already carries an
+            # explicit Content-Length, so persistent connections are
+            # safe — sequential admissions from one client reuse a
+            # single socket instead of paying setup per request
+            # (docs/ingest.md §Keep-alive). Chunked bodies are not
+            # produced or accepted.
+            protocol_version = "HTTP/1.1"
+
             def do_POST(self):  # noqa: N802
                 # in-flight accounting: an ACCEPTED request must finish
                 # even when stop() runs concurrently — the drain waits
@@ -1267,6 +1329,18 @@ class WebhookServer:
                     trace_id = parse_traceparent(
                         self.headers.get("traceparent")
                     ) or derive_trace_id(request.get("uid"))
+                    if (
+                        outer.decision_log is not None
+                        and trace_id is not None
+                    ):
+                        # front-door attribution (docs/ingest.md): the
+                        # decision record names which decode route
+                        # served this admission and what it weighed
+                        outer.decision_log.note_dispatch(
+                            trace_id,
+                            decode_route="legacy",
+                            bytes_on_wire=length,
+                        )
                     if self.path == "/v1/admitlabel":
                         resp = outer.label_handler.handle(request)
                     elif self.path == "/v1/mutate":
@@ -1381,6 +1455,8 @@ class WebhookServer:
             self.agent_batcher.start()
         if self.agent_mutate_batcher is not None:
             self.agent_mutate_batcher.start()
+        if self.ingest is not None:
+            self.ingest.start()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -1490,9 +1566,14 @@ class WebhookServer:
         if grace > 0:
             time.sleep(grace)
         self._httpd.shutdown()
+        if self.ingest is not None:
+            # stop NEW frames; accepted ones are in _inflight below
+            self.ingest.stop_accepting()
         # bounded by the request envelope: no accepted request can
         # legitimately outlive its own timeout + a dispatch window
         self._await_inflight(min(self.request_timeout + 1.0, 15.0))
+        if self.ingest is not None:
+            self.ingest.close()
         self.batcher.stop()
         if self.mutate_batcher is not None:
             self.mutate_batcher.stop()

@@ -1,0 +1,498 @@
+"""Wire-speed ingest plane tests (docs/ingest.md): the frame codec,
+listener robustness under malformed input, zero-copy decode parity
+with json.loads over a policy-shaped corpus, and the front-door
+contracts — HTTP/1.1 keep-alive socket reuse on the legacy server and
+framed-vs-HTTP verdict byte parity on the stream listener."""
+
+import http.client
+import json
+import random
+import socket
+import struct
+import threading
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.constraint import Backend, K8sValidationTarget, TpuDriver
+from gatekeeper_tpu.ingest.decode import (
+    DecodeSurprise,
+    LazyObject,
+    decode_review,
+    scan_review,
+)
+from gatekeeper_tpu.ingest.transport import (
+    DEFAULT_MAX_INFLIGHT,
+    FRAME_ERROR,
+    FRAME_HEADER,
+    FRAME_PING,
+    FRAME_PONG,
+    FRAME_RESPONSE,
+    FRAME_VERSION,
+    FLAG_DEADLINE,
+    BadFrameType,
+    BadVersion,
+    FrameReader,
+    FrameTooLarge,
+    PLANE_AGENT,
+    PLANE_MUTATE,
+    PLANE_VALIDATE,
+    ShortFrame,
+    StreamClient,
+    StreamListener,
+    encode_frame,
+)
+from gatekeeper_tpu.webhook import WebhookServer
+
+pytestmark = pytest.mark.ingest
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+_PRIV_REGO = """package privileged
+
+violation[{"msg": msg}] {
+    c := input.review.object.spec.containers[_]
+    c.securityContext.privileged
+    msg := sprintf("privileged container %v", [c.name])
+}
+"""
+
+
+def _template(kind, rego):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": TARGET, "rego": rego}],
+        },
+    }
+
+
+def _constraint(kind, name, match=None):
+    spec = {}
+    if match is not None:
+        spec["match"] = match
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def _review_body(i=0, violating=True, extra_meta=None):
+    sc = {"privileged": True} if violating else {}
+    meta = {
+        "name": f"req{i}",
+        "namespace": f"ns{i % 7}",
+        "labels": {"app": f"svc{i % 3}"},
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    return json.dumps({
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": f"uid-{i}",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "operation": "CREATE",
+            "name": meta["name"],
+            "namespace": meta["namespace"],
+            "userInfo": {"username": "ingest-test"},
+            "object": {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": meta,
+                "spec": {
+                    "containers": [{
+                        "name": "main",
+                        "image": "nginx",
+                        "securityContext": sc,
+                    }],
+                },
+            },
+        },
+    }).encode()
+
+
+@pytest.fixture()
+def client():
+    cl = Backend(TpuDriver()).new_client(K8sValidationTarget())
+    cl.add_template(_template("IngestPriv", _PRIV_REGO))
+    cl.add_constraint(_constraint(
+        "IngestPriv", "no-priv",
+        match={"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+    ))
+    return cl
+
+
+# -- frame codec --------------------------------------------------------------
+
+
+def test_frame_codec_round_trip_all_planes():
+    reader = FrameReader()
+    cases = [
+        (PLANE_VALIDATE, 1, b'{"a":1}', 250),
+        (PLANE_MUTATE, 2, b"x" * 1000, 0),
+        (PLANE_AGENT, 3, b"", 50),
+        (FRAME_RESPONSE, 4, b"ok", 200),
+        (FRAME_ERROR, 5, b"bad", 400),
+        (FRAME_PING, 6, b"", 0),
+        (FRAME_PONG, 7, b"", 0),
+    ]
+    wire = b"".join(
+        encode_frame(t, rid, payload, budget=b)
+        for t, rid, payload, b in cases
+    )
+    frames = reader.feed(wire)
+    assert reader.pending_bytes() == 0
+    assert len(frames) == len(cases)
+    for frame, (t, rid, payload, b) in zip(frames, cases):
+        assert frame.ftype == t
+        assert frame.request_id == rid
+        assert bytes(frame.payload) == payload
+        assert frame.budget == b
+        # the deadline flag rides exactly the frames that carry one
+        assert frame.flags == (FLAG_DEADLINE if b else 0)
+
+
+def test_frame_reader_reassembles_byte_at_a_time():
+    body = _review_body(9)
+    wire = encode_frame(PLANE_VALIDATE, 77, body, budget=500)
+    reader = FrameReader()
+    frames = []
+    for i in range(len(wire)):
+        frames.extend(reader.feed(wire[i:i + 1]))
+    assert len(frames) == 1
+    assert frames[0].request_id == 77
+    assert bytes(frames[0].payload) == body
+
+
+def test_frame_reader_rejects_malformed():
+    # oversized declared length
+    with pytest.raises(FrameTooLarge):
+        FrameReader(max_frame=1024).feed(
+            struct.pack(">I", 1024 + FRAME_HEADER.size + 1)
+        )
+    # length shorter than a header can be
+    with pytest.raises(ShortFrame):
+        FrameReader().feed(struct.pack(">I", FRAME_HEADER.size - 1))
+    # wrong version byte
+    hdr = FRAME_HEADER.pack(FRAME_VERSION + 1, PLANE_VALIDATE, 0, 0, 0, 1)
+    with pytest.raises(BadVersion):
+        FrameReader().feed(struct.pack(">I", len(hdr)) + hdr)
+    # unknown frame type
+    hdr = FRAME_HEADER.pack(FRAME_VERSION, 0x7A, 0, 0, 0, 1)
+    with pytest.raises(BadFrameType):
+        FrameReader().feed(struct.pack(">I", len(hdr)) + hdr)
+
+
+# -- listener robustness ------------------------------------------------------
+
+
+def _echo_listener():
+    listener = StreamListener(
+        lambda frame: (200, bytes(frame.payload)),
+        host="127.0.0.1", port=0, max_frame=64 * 1024,
+    )
+    listener.start()
+    return listener
+
+
+def _serves_ok(listener):
+    with StreamClient("127.0.0.1", listener.port) as c:
+        status, payload = c.request(b"still-alive", timeout=5.0)
+    return status == 200 and payload == b"still-alive"
+
+
+def test_listener_sheds_malformed_and_keeps_serving():
+    listener = _echo_listener()
+    try:
+        blobs = [
+            b"GET / HTTP/1.1\r\n\r\n",          # not a frame at all
+            struct.pack(">I", 10 ** 8),          # oversize declaration
+            struct.pack(">I", 2),                # shorter than a header
+            encode_frame(PLANE_VALIDATE, 1, b"x")[:9],  # truncated
+            FRAME_HEADER.pack(9, PLANE_VALIDATE, 0, 0, 0, 1),
+        ]
+        for blob in blobs:
+            s = socket.create_connection(("127.0.0.1", listener.port))
+            try:
+                s.sendall(struct.pack(">I", 0) if not blob else blob)
+                s.settimeout(2.0)
+                try:
+                    s.recv(4096)  # error frame or straight close
+                except OSError:
+                    pass
+            finally:
+                s.close()
+        stats = listener.stats()
+        assert stats["protocol_errors_total"] > 0
+        # every malformed conn was shed, none crashed the listener
+        assert _serves_ok(listener)
+        assert listener.stats()["connections_active"] >= 0
+    finally:
+        listener.close()
+
+
+def test_listener_survives_seeded_garbage_fuzz():
+    """No byte blob may crash a listener thread: each garbage
+    connection is shed with a protocol error (or ignored as an
+    incomplete frame) and the NEXT client still gets served."""
+    listener = _echo_listener()
+    rng = random.Random(1311)
+    try:
+        for _ in range(60):
+            n = rng.randrange(1, 200)
+            blob = bytes(rng.randrange(256) for _ in range(n))
+            s = socket.create_connection(("127.0.0.1", listener.port))
+            try:
+                s.sendall(blob)
+            except OSError:
+                pass
+            finally:
+                s.close()
+        assert _serves_ok(listener)
+    finally:
+        listener.close()
+
+
+def test_listener_ping_pong_and_multiplexing():
+    listener = _echo_listener()
+    try:
+        with StreamClient("127.0.0.1", listener.port) as c:
+            futs = [
+                c.submit(f"payload-{i}".encode(), PLANE_VALIDATE)
+                for i in range(32)
+            ]
+            for i, fut in enumerate(futs):
+                status, payload = fut.result(timeout=5.0)
+                assert status == 200
+                assert payload == f"payload-{i}".encode()
+        stats = listener.stats()
+        assert stats["frames_total"] >= 32
+        assert stats["connections_total"] >= 1
+    finally:
+        listener.close()
+
+
+# -- zero-copy decode parity --------------------------------------------------
+
+
+def _parity_corpus():
+    """Policy-shaped bodies plus the JSON shapes that historically
+    break hand-rolled scanners: unicode + escapes, exotic numbers,
+    deep nesting, empty containers, huge strings, and the external-
+    data/partial-rows review shapes the planes actually ship."""
+    bodies = [_review_body(i, violating=bool(i % 2)) for i in range(8)]
+    bodies.append(_review_body(3, extra_meta={
+        "annotations": {
+            "unicode": "påd-中文-\U0001f600",
+            "escapes": "tab\tnl\nquote\"back\\slash/solidus",
+            "controls": "\u0000\u001f",
+        },
+    }))
+    bodies.extend(json.dumps(doc).encode() for doc in [
+        {"numbers": [0, -0, 1e10, -1.5e-7, 0.25, 123456789012345678,
+                     3.141592653589793, 1e308]},
+        {"empties": [{}, [], "", {"nested": {}}]},
+        {"bools": [True, False, None, {"t": True}]},
+        {"deep": {"a": {"b": {"c": {"d": {"e": [[[[1]]]]}}}}}},
+        {"big": "x" * 70000, "after": 1},
+        {"request": {"object": None, "oldObject": None}},
+        # external-data shaped review: provider keys ride the object
+        {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+         "request": {"uid": "e1", "object": {
+             "apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "ext", "annotations": {
+                 "provider-key": "artifactory.example/img:1"}},
+             "spec": {"containers": [
+                 {"name": "a", "image": "reg.example/app@sha256:ab"},
+             ]}}}},
+    ])
+    # whitespace variants: the scanner must agree with json.loads on
+    # permissive inter-token whitespace
+    bodies.append(
+        b'  {\n\t"apiVersion" :\r\n "admission.k8s.io/v1" , '
+        b'"kind":"AdmissionReview","request":{"uid":" u "}}  '
+    )
+    return bodies
+
+
+def _deep_materialize(x):
+    if isinstance(x, dict):
+        return {k: _deep_materialize(v) for k, v in x.items()}
+    if isinstance(x, list):
+        return [_deep_materialize(v) for v in x]
+    return x
+
+
+def test_decode_parity_with_json_loads():
+    for body in _parity_corpus():
+        review, route, reason = decode_review(body)
+        assert route in ("zerocopy", "fallback"), (route, body[:60])
+        assert _deep_materialize(review) == json.loads(body), body[:80]
+
+
+def test_decode_rejects_what_json_rejects():
+    for bad in (b"", b"{", b'{"a":', b"nope", b'{"a":1}trail',
+                b'[1,2,]'):
+        with pytest.raises(ValueError):
+            json.loads(bad)
+        with pytest.raises(ValueError):
+            decode_review(bad)
+
+
+def test_decode_fallback_on_duplicate_keys_matches_json():
+    """Duplicate object keys are a scanner surprise (last-wins vs
+    first-wins ambiguity): decode_review must fall back to json.loads
+    and return its answer, with the reason recorded."""
+    body = b'{"a": 1, "a": 2, "b": {"c": 3, "c": 4}}'
+    with pytest.raises(DecodeSurprise):
+        scan_review(body)
+    review, route, reason = decode_review(body)
+    assert route == "fallback"
+    assert reason == "dup_key"
+    assert review == json.loads(body)
+
+
+def test_lazy_object_defers_materialization():
+    body = _review_body(5)
+    hits = []
+    review = scan_review(body, on_materialize=lambda: hits.append(1))
+    obj = review["request"]["object"]
+    assert isinstance(obj, LazyObject)
+    # the lifted keys (gvk + metadata) never cost a materialization —
+    # the match-feature encoder reads them on every review
+    assert obj["kind"] == "Pod"
+    assert obj["metadata"]["name"] == "req5"
+    assert not hits
+    rows = obj._preflat_rows
+    assert rows, "object subtree must carry pre-flattened token rows"
+    # touching past the lifted keys materializes exactly once
+    assert obj["spec"]["containers"][0]["image"] == "nginx"
+    assert obj["spec"]["containers"][0]["name"] == "main"
+    assert hits == [1]
+
+
+# -- server-level contracts ---------------------------------------------------
+
+
+def _start_server(client, **kw):
+    server = WebhookServer(
+        client, TARGET, window_ms=2.0, request_timeout=30, **kw
+    )
+    server.start()
+    return server
+
+
+def test_http11_keepalive_reuses_socket(client):
+    """The legacy front door speaks HTTP/1.1 with keep-alive: two
+    sequential requests must ride ONE kernel socket (the
+    conn-per-request tax the framed plane's bench quantifies was paid
+    per request before this)."""
+    server = _start_server(client)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port)
+        try:
+            fds = []
+            for i in range(2):
+                conn.request(
+                    "POST", "/v1/admit", body=_review_body(i),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                doc = json.loads(resp.read())
+                assert resp.status == 200
+                assert doc["response"]["uid"] == f"uid-{i}"
+                fds.append(conn.sock.fileno())
+            assert fds[0] == fds[1], "keep-alive must reuse the socket"
+        finally:
+            conn.close()
+    finally:
+        server.stop()
+
+
+def test_framed_and_http_verdicts_byte_identical(client):
+    """The framed front door is a TRANSPORT, not a dialect: the same
+    AdmissionReview body must produce byte-identical verdict JSON over
+    the stream listener and the HTTP endpoint."""
+    server = _start_server(client, ingest=True)
+    try:
+        for i, violating in ((0, True), (1, False)):
+            body = _review_body(i, violating=violating)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/admit", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                http_bytes = resp.read()
+            with StreamClient("127.0.0.1", server.ingest.port) as c:
+                status, framed_bytes = c.request(
+                    body, PLANE_VALIDATE, budget_ms=5000, timeout=10.0
+                )
+            assert status == 200
+            assert framed_bytes == http_bytes
+            doc = json.loads(framed_bytes)
+            assert doc["response"]["allowed"] is (not violating)
+        stats = server.ingest.stats()
+        assert stats["decode"]["zerocopy"] >= 2
+        assert stats["decode"]["fallback"] == 0
+    finally:
+        server.stop()
+
+
+def test_ingest_server_fallback_counter(client):
+    """A wire body the scanner declines (duplicate keys) must still be
+    served — json.loads route — with the fallback counted."""
+    dup_body = (
+        b'{"apiVersion":"admission.k8s.io/v1","kind":"AdmissionReview",'
+        b'"request":{"uid":"dup-1","uid":"dup-1",'
+        b'"kind":{"group":"","version":"v1","kind":"Pod"},'
+        b'"operation":"CREATE",'
+        b'"object":{"apiVersion":"v1","kind":"Pod",'
+        b'"metadata":{"name":"d","namespace":"ns0"},'
+        b'"spec":{"containers":[{"name":"c","image":"nginx"}]}}}}'
+    )
+    server = _start_server(client, ingest=True)
+    try:
+        with StreamClient("127.0.0.1", server.ingest.port) as c:
+            status, payload = c.request(
+                dup_body, PLANE_VALIDATE, budget_ms=5000, timeout=10.0
+            )
+        assert status == 200
+        assert json.loads(payload)["response"]["uid"] == "dup-1"
+        assert server.ingest.stats()["decode"]["fallback"] >= 1
+    finally:
+        server.stop()
+
+
+def test_stream_client_close_releases_server_connection(client):
+    """shutdown-before-close regression (docs/ingest.md §Shutdown): a
+    StreamClient whose reader thread is blocked in recv() must still
+    push a FIN on close, so the listener's connection count returns to
+    zero instead of leaking one kernel socket per client."""
+    server = _start_server(client, ingest=True)
+    try:
+        clients = [
+            StreamClient("127.0.0.1", server.ingest.port)
+            for _ in range(4)
+        ]
+        for i, c in enumerate(clients):
+            status, _ = c.request(
+                _review_body(i), PLANE_VALIDATE, timeout=10.0
+            )
+            assert status == 200
+        assert server.ingest.stats()["connections_active"] == 4
+        for c in clients:
+            c.close()
+        deadline = 50
+        while deadline and server.ingest.stats()["connections_active"]:
+            threading.Event().wait(0.1)
+            deadline -= 1
+        assert server.ingest.stats()["connections_active"] == 0
+    finally:
+        server.stop()
